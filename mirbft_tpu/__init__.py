@@ -1,0 +1,11 @@
+"""mirbft_tpu: a TPU-native Byzantine-fault-tolerant atomic broadcast framework.
+
+A ground-up rebuild of the capabilities of MirBFT (reference at
+/root/reference; see SURVEY.md): the multi-leader Mir consensus protocol as a
+deterministic, I/O-free protocol state machine behind an Actions→Results seam,
+with the executor realized as a JAX/XLA/Pallas compute plane — batched SHA-256
+digests, request verification, and quorum tallies run as vmapped TPU kernels
+while the branchy protocol logic stays on the host.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,773 @@
+"""Schema layer: every wire message, WAL entry, state event, and hash-origin
+type in the framework.
+
+This is the TPU-native rebuild's equivalent of the reference's protobuf schema
+(reference: mirbftpb/mirbft.proto:1-455).  Same message vocabulary — 15 network
+message types (mirbft.proto:193-211), 8 persistent WAL entry types
+(mirbft.proto:131-143), 10 state-event input types (mirbft.proto:353-406), 5
+hash-origin types (mirbft.proto:408-448) — expressed as Python dataclasses
+with the deterministic codec from ``wire``.
+
+Everything above this layer depends on it; it depends on nothing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import wire
+from .wire import BOOL, BYTES, I32, U32, U64, Nested, OneOf, Rep
+
+
+# ---------------------------------------------------------------------------
+# Network state (reference: mirbft.proto:22-115)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NetworkConfig:
+    """Consensus-replicated network configuration (mirbft.proto:23-77)."""
+
+    nodes: list = field(default_factory=list)  # active node IDs; len == N
+    checkpoint_interval: int = 0  # sequences between checkpoints
+    max_epoch_length: int = 0  # max seqnos preprepared per epoch
+    number_of_buckets: int = 0  # partitions of the request space
+    f: int = 0  # byzantine faults tolerated, < N/3
+
+
+@dataclass
+class NetworkClient:
+    """Per-client window state, reflected in checkpoints (mirbft.proto:79-106)."""
+
+    id: int = 0
+    width: int = 0
+    width_consumed_last_checkpoint: int = 0
+    low_watermark: int = 0  # lowest uncommitted req_no
+    committed_mask: bytes = b""  # bitmask of commits above low_watermark
+
+
+@dataclass
+class ReconfigNewClient:
+    id: int = 0
+    width: int = 0
+
+
+@dataclass
+class ReconfigRemoveClient:
+    client_id: int = 0
+
+
+@dataclass
+class Reconfiguration:
+    """Oneof: ReconfigNewClient | ReconfigRemoveClient | NetworkConfig
+    (mirbft.proto:117-128)."""
+
+    type: object = None
+
+
+@dataclass
+class NetworkState:
+    config: NetworkConfig | None = None
+    clients: list = field(default_factory=list)  # [NetworkClient]
+    pending_reconfigurations: list = field(default_factory=list)
+    reconfigured: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Requests and acks (mirbft.proto:229-239)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Request:
+    client_id: int = 0
+    req_no: int = 0
+    data: bytes = b""
+
+
+@dataclass
+class RequestAck:
+    client_id: int = 0
+    req_no: int = 0
+    digest: bytes = b""
+
+
+# ---------------------------------------------------------------------------
+# Epoch configuration (mirbft.proto:309-351)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EpochConfig:
+    number: int = 0
+    leaders: list = field(default_factory=list)  # node IDs
+    planned_expiration: int = 0  # last seq_no this epoch may preprepare
+
+
+@dataclass
+class Checkpoint:
+    seq_no: int = 0
+    value: bytes = b""
+
+
+@dataclass
+class NewEpochConfig:
+    config: EpochConfig | None = None
+    starting_checkpoint: Checkpoint | None = None
+    # Digests finalizing in-flight sequences above the starting checkpoint,
+    # indexed by seq_no offset; empty digest == null request.
+    final_preprepares: list = field(default_factory=list)  # [bytes]
+
+
+@dataclass
+class EpochChangeSetEntry:
+    epoch: int = 0
+    seq_no: int = 0
+    digest: bytes = b""
+
+
+@dataclass
+class EpochChange:
+    """PBFT view-change message, slightly adapted to Mir (mirbft.proto:273-293)."""
+
+    new_epoch: int = 0
+    checkpoints: list = field(default_factory=list)  # [Checkpoint] — the C-set
+    p_set: list = field(default_factory=list)  # [EpochChangeSetEntry]
+    q_set: list = field(default_factory=list)  # [EpochChangeSetEntry]
+
+
+@dataclass
+class EpochChangeAck:
+    originator: int = 0
+    epoch_change: EpochChange | None = None
+
+
+@dataclass
+class RemoteEpochChange:
+    node_id: int = 0
+    digest: bytes = b""
+
+
+@dataclass
+class NewEpoch:
+    """PBFT NewView + Bracha reliable broadcast of the config (mirbft.proto:330-351)."""
+
+    new_config: NewEpochConfig | None = None
+    epoch_changes: list = field(default_factory=list)  # [RemoteEpochChange]
+
+
+# ---------------------------------------------------------------------------
+# Normal-case three-phase messages (mirbft.proto:241-266)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Preprepare:
+    seq_no: int = 0
+    epoch: int = 0
+    batch: list = field(default_factory=list)  # [RequestAck]
+
+
+@dataclass
+class Prepare:
+    seq_no: int = 0
+    epoch: int = 0
+    digest: bytes = b""
+
+
+@dataclass
+class Commit:
+    seq_no: int = 0
+    epoch: int = 0
+    digest: bytes = b""
+
+
+@dataclass
+class Suspect:
+    epoch: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Fetch / forward (mirbft.proto:213-227)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FetchBatch:
+    seq_no: int = 0
+    digest: bytes = b""
+
+
+@dataclass
+class ForwardBatch:
+    seq_no: int = 0
+    request_acks: list = field(default_factory=list)  # [RequestAck]
+    digest: bytes = b""
+
+
+@dataclass
+class FetchRequest:
+    """Distinct type for the fetch_request oneof arm (the reference reuses
+    RequestAck at mirbft.proto:207; a distinct type keeps step routing
+    explicit)."""
+
+    client_id: int = 0
+    req_no: int = 0
+    digest: bytes = b""
+
+
+@dataclass
+class ForwardRequest:
+    request_ack: RequestAck | None = None
+    request_data: bytes = b""
+
+
+@dataclass
+class Msg:
+    """The wire-message oneof: 15 types (mirbft.proto:193-211)."""
+
+    type: object = None
+
+
+# ---------------------------------------------------------------------------
+# Persistent (WAL) entries (mirbft.proto:131-191)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QEntry:
+    """Persisted before a batch is Preprepared (mirbft.proto:170-177)."""
+
+    seq_no: int = 0
+    digest: bytes = b""
+    requests: list = field(default_factory=list)  # [RequestAck]
+
+
+@dataclass
+class PEntry:
+    """Persisted before a batch is Prepared (mirbft.proto:179-184)."""
+
+    seq_no: int = 0
+    digest: bytes = b""
+
+
+@dataclass
+class CEntry:
+    """Persisted before a Checkpoint message is sent (mirbft.proto:186-191)."""
+
+    seq_no: int = 0
+    checkpoint_value: bytes = b""
+    network_state: NetworkState | None = None
+
+
+@dataclass
+class NEntry:
+    """New sequence allocation; persisted before log truncation (mirbft.proto:148-152)."""
+
+    seq_no: int = 0
+    epoch_config: EpochConfig | None = None
+
+
+@dataclass
+class FEntry:
+    """Epoch gracefully ended (mirbft.proto:154-156)."""
+
+    ends_epoch_config: EpochConfig | None = None
+
+
+@dataclass
+class ECEntry:
+    """Epoch change sent; truncation halts until the next epoch (mirbft.proto:160-162)."""
+
+    epoch_number: int = 0
+
+
+@dataclass
+class TEntry:
+    """State transfer requested (mirbft.proto:164-168)."""
+
+    seq_no: int = 0
+    value: bytes = b""
+
+
+@dataclass
+class Persistent:
+    """WAL entry oneof: 8 types (mirbft.proto:131-143)."""
+
+    type: object = None
+
+
+# ---------------------------------------------------------------------------
+# Hash results (mirbft.proto:408-448)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class HashOriginRequest:
+    source: int = 0
+    request: Request | None = None
+
+
+@dataclass
+class HashOriginVerifyRequest:
+    source: int = 0
+    request_ack: RequestAck | None = None
+    request_data: bytes = b""
+
+
+@dataclass
+class HashOriginBatch:
+    source: int = 0
+    epoch: int = 0
+    seq_no: int = 0
+    request_acks: list = field(default_factory=list)  # [RequestAck]
+
+
+@dataclass
+class HashOriginVerifyBatch:
+    source: int = 0
+    seq_no: int = 0
+    request_acks: list = field(default_factory=list)  # [RequestAck]
+    expected_digest: bytes = b""
+
+
+@dataclass
+class HashOriginEpochChange:
+    source: int = 0
+    origin: int = 0
+    epoch_change: EpochChange | None = None
+
+
+@dataclass
+class HashResult:
+    digest: bytes = b""
+    type: object = None  # one of the 5 HashOrigin* classes
+
+
+@dataclass
+class CheckpointResult:
+    """Consumer-computed checkpoint (mirbft.proto:450-455)."""
+
+    seq_no: int = 0
+    value: bytes = b""
+    network_state: NetworkState | None = None
+    reconfigured: bool = False
+
+
+# ---------------------------------------------------------------------------
+# State events (mirbft.proto:353-406)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class InitialParameters:
+    id: int = 0
+    batch_size: int = 0
+    heartbeat_ticks: int = 0
+    suspect_ticks: int = 0
+    new_epoch_timeout_ticks: int = 0
+    buffer_size: int = 0
+
+
+@dataclass
+class EventInitialize:
+    initial_parms: InitialParameters | None = None
+
+
+@dataclass
+class EventLoadEntry:
+    index: int = 0
+    data: Persistent | None = None
+
+
+@dataclass
+class EventLoadRequest:
+    request_ack: RequestAck | None = None
+
+
+@dataclass
+class EventCompleteInitialization:
+    pass
+
+
+@dataclass
+class EventActionResults:
+    digests: list = field(default_factory=list)  # [HashResult]
+    checkpoints: list = field(default_factory=list)  # [CheckpointResult]
+
+
+@dataclass
+class EventTransfer:
+    c_entry: CEntry | None = None
+
+
+@dataclass
+class EventPropose:
+    request: Request | None = None
+
+
+@dataclass
+class EventStep:
+    source: int = 0
+    msg: Msg | None = None
+
+
+@dataclass
+class EventTick:
+    pass
+
+
+@dataclass
+class EventActionsReceived:
+    pass
+
+
+@dataclass
+class StateEvent:
+    """The state-machine input oneof: 10 types (mirbft.proto:394-405)."""
+
+    type: object = None
+
+
+# ---------------------------------------------------------------------------
+# Specs (encoding order == declaration order)
+# ---------------------------------------------------------------------------
+
+NetworkConfig._spec_ = (
+    ("nodes", Rep(U64)),
+    ("checkpoint_interval", I32),
+    ("max_epoch_length", U64),
+    ("number_of_buckets", I32),
+    ("f", I32),
+)
+NetworkClient._spec_ = (
+    ("id", U64),
+    ("width", U32),
+    ("width_consumed_last_checkpoint", U32),
+    ("low_watermark", U64),
+    ("committed_mask", BYTES),
+)
+ReconfigNewClient._spec_ = (("id", U64), ("width", U32))
+ReconfigRemoveClient._spec_ = (("client_id", U64),)
+Reconfiguration._spec_ = (
+    (
+        "type",
+        OneOf(
+            (1, ReconfigNewClient),
+            (2, ReconfigRemoveClient),
+            (3, NetworkConfig),
+        ),
+    ),
+)
+NetworkState._spec_ = (
+    ("config", Nested(NetworkConfig)),
+    ("clients", Rep(Nested(NetworkClient))),
+    ("pending_reconfigurations", Rep(Nested(Reconfiguration))),
+    ("reconfigured", BOOL),
+)
+
+Request._spec_ = (("client_id", U64), ("req_no", U64), ("data", BYTES))
+RequestAck._spec_ = (("client_id", U64), ("req_no", U64), ("digest", BYTES))
+
+EpochConfig._spec_ = (
+    ("number", U64),
+    ("leaders", Rep(U64)),
+    ("planned_expiration", U64),
+)
+Checkpoint._spec_ = (("seq_no", U64), ("value", BYTES))
+NewEpochConfig._spec_ = (
+    ("config", Nested(EpochConfig)),
+    ("starting_checkpoint", Nested(Checkpoint)),
+    ("final_preprepares", Rep(BYTES)),
+)
+EpochChangeSetEntry._spec_ = (
+    ("epoch", U64),
+    ("seq_no", U64),
+    ("digest", BYTES),
+)
+EpochChange._spec_ = (
+    ("new_epoch", U64),
+    ("checkpoints", Rep(Nested(Checkpoint))),
+    ("p_set", Rep(Nested(EpochChangeSetEntry))),
+    ("q_set", Rep(Nested(EpochChangeSetEntry))),
+)
+EpochChangeAck._spec_ = (
+    ("originator", U64),
+    ("epoch_change", Nested(EpochChange)),
+)
+RemoteEpochChange._spec_ = (("node_id", U64), ("digest", BYTES))
+NewEpoch._spec_ = (
+    ("new_config", Nested(NewEpochConfig)),
+    ("epoch_changes", Rep(Nested(RemoteEpochChange))),
+)
+
+Preprepare._spec_ = (
+    ("seq_no", U64),
+    ("epoch", U64),
+    ("batch", Rep(Nested(RequestAck))),
+)
+Prepare._spec_ = (("seq_no", U64), ("epoch", U64), ("digest", BYTES))
+Commit._spec_ = (("seq_no", U64), ("epoch", U64), ("digest", BYTES))
+Suspect._spec_ = (("epoch", U64),)
+
+FetchBatch._spec_ = (("seq_no", U64), ("digest", BYTES))
+ForwardBatch._spec_ = (
+    ("seq_no", U64),
+    ("request_acks", Rep(Nested(RequestAck))),
+    ("digest", BYTES),
+)
+FetchRequest._spec_ = (("client_id", U64), ("req_no", U64), ("digest", BYTES))
+ForwardRequest._spec_ = (
+    ("request_ack", Nested(RequestAck)),
+    ("request_data", BYTES),
+)
+
+Msg._spec_ = (
+    (
+        "type",
+        OneOf(
+            (1, Preprepare),
+            (2, Prepare),
+            (3, Commit),
+            (4, Checkpoint),
+            (5, Suspect),
+            (6, EpochChange),
+            (7, EpochChangeAck),
+            (8, NewEpoch),
+            (9, NewEpochConfig),  # new_epoch_echo — see msg wrappers below
+            (11, FetchBatch),
+            (12, ForwardBatch),
+            (13, FetchRequest),
+            (14, ForwardRequest),
+            (15, RequestAck),
+        ),
+    ),
+)
+
+QEntry._spec_ = (
+    ("seq_no", U64),
+    ("digest", BYTES),
+    ("requests", Rep(Nested(RequestAck))),
+)
+PEntry._spec_ = (("seq_no", U64), ("digest", BYTES))
+CEntry._spec_ = (
+    ("seq_no", U64),
+    ("checkpoint_value", BYTES),
+    ("network_state", Nested(NetworkState)),
+)
+NEntry._spec_ = (("seq_no", U64), ("epoch_config", Nested(EpochConfig)))
+FEntry._spec_ = (("ends_epoch_config", Nested(EpochConfig)),)
+ECEntry._spec_ = (("epoch_number", U64),)
+TEntry._spec_ = (("seq_no", U64), ("value", BYTES))
+Persistent._spec_ = (
+    (
+        "type",
+        OneOf(
+            (1, QEntry),
+            (2, PEntry),
+            (3, CEntry),
+            (4, NEntry),
+            (5, FEntry),
+            (6, ECEntry),
+            (7, TEntry),
+            (8, Suspect),
+        ),
+    ),
+)
+
+HashOriginRequest._spec_ = (("source", U64), ("request", Nested(Request)))
+HashOriginVerifyRequest._spec_ = (
+    ("source", U64),
+    ("request_ack", Nested(RequestAck)),
+    ("request_data", BYTES),
+)
+HashOriginBatch._spec_ = (
+    ("source", U64),
+    ("epoch", U64),
+    ("seq_no", U64),
+    ("request_acks", Rep(Nested(RequestAck))),
+)
+HashOriginVerifyBatch._spec_ = (
+    ("source", U64),
+    ("seq_no", U64),
+    ("request_acks", Rep(Nested(RequestAck))),
+    ("expected_digest", BYTES),
+)
+HashOriginEpochChange._spec_ = (
+    ("source", U64),
+    ("origin", U64),
+    ("epoch_change", Nested(EpochChange)),
+)
+HashResult._spec_ = (
+    ("digest", BYTES),
+    (
+        "type",
+        OneOf(
+            (1, HashOriginRequest),
+            (2, HashOriginBatch),
+            (3, HashOriginEpochChange),
+            (4, HashOriginVerifyBatch),
+            (5, HashOriginVerifyRequest),
+        ),
+    ),
+)
+CheckpointResult._spec_ = (
+    ("seq_no", U64),
+    ("value", BYTES),
+    ("network_state", Nested(NetworkState)),
+    ("reconfigured", BOOL),
+)
+
+InitialParameters._spec_ = (
+    ("id", U64),
+    ("batch_size", U32),
+    ("heartbeat_ticks", U32),
+    ("suspect_ticks", U32),
+    ("new_epoch_timeout_ticks", U32),
+    ("buffer_size", U32),
+)
+EventInitialize._spec_ = (("initial_parms", Nested(InitialParameters)),)
+EventLoadEntry._spec_ = (("index", U64), ("data", Nested(Persistent)))
+EventLoadRequest._spec_ = (("request_ack", Nested(RequestAck)),)
+EventCompleteInitialization._spec_ = ()
+EventActionResults._spec_ = (
+    ("digests", Rep(Nested(HashResult))),
+    ("checkpoints", Rep(Nested(CheckpointResult))),
+)
+EventTransfer._spec_ = (("c_entry", Nested(CEntry)),)
+EventPropose._spec_ = (("request", Nested(Request)),)
+EventStep._spec_ = (("source", U64), ("msg", Nested(Msg)))
+EventTick._spec_ = ()
+EventActionsReceived._spec_ = ()
+StateEvent._spec_ = (
+    (
+        "type",
+        OneOf(
+            (1, EventInitialize),
+            (2, EventLoadEntry),
+            (3, EventLoadRequest),
+            (4, EventCompleteInitialization),
+            (5, EventActionResults),
+            (6, EventTransfer),
+            (7, EventPropose),
+            (8, EventStep),
+            (9, EventTick),
+            (10, EventActionsReceived),
+        ),
+    ),
+)
+
+_ALL_MESSAGES = [
+    NetworkConfig,
+    NetworkClient,
+    ReconfigNewClient,
+    ReconfigRemoveClient,
+    Reconfiguration,
+    NetworkState,
+    Request,
+    RequestAck,
+    EpochConfig,
+    Checkpoint,
+    NewEpochConfig,
+    EpochChangeSetEntry,
+    EpochChange,
+    EpochChangeAck,
+    RemoteEpochChange,
+    NewEpoch,
+    Preprepare,
+    Prepare,
+    Commit,
+    Suspect,
+    FetchBatch,
+    ForwardBatch,
+    FetchRequest,
+    ForwardRequest,
+    Msg,
+    QEntry,
+    PEntry,
+    CEntry,
+    NEntry,
+    FEntry,
+    ECEntry,
+    TEntry,
+    Persistent,
+    HashOriginRequest,
+    HashOriginVerifyRequest,
+    HashOriginBatch,
+    HashOriginVerifyBatch,
+    HashOriginEpochChange,
+    HashResult,
+    CheckpointResult,
+    InitialParameters,
+    EventInitialize,
+    EventLoadEntry,
+    EventLoadRequest,
+    EventCompleteInitialization,
+    EventActionResults,
+    EventTransfer,
+    EventPropose,
+    EventStep,
+    EventTick,
+    EventActionsReceived,
+    StateEvent,
+]
+
+for _cls in _ALL_MESSAGES:
+    wire.check_spec(_cls)
+
+
+# ---------------------------------------------------------------------------
+# Msg wrappers.  The Msg oneof reuses NewEpochConfig for both echo (tag 9) and
+# ready (tag 10) in the reference (mirbft.proto:203-204), and RequestAck for
+# both fetch_request (13) and request_ack (15).  We disambiguate echo/ready
+# with an explicit wrapper and fetch/ack with the distinct FetchRequest class.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NewEpochEcho:
+    new_epoch_config: NewEpochConfig | None = None
+
+
+@dataclass
+class NewEpochReady:
+    new_epoch_config: NewEpochConfig | None = None
+
+
+NewEpochEcho._spec_ = (("new_epoch_config", Nested(NewEpochConfig)),)
+NewEpochReady._spec_ = (("new_epoch_config", Nested(NewEpochConfig)),)
+wire.check_spec(NewEpochEcho)
+wire.check_spec(NewEpochReady)
+
+# Rebuild the Msg oneof with the explicit echo/ready wrappers.
+Msg._spec_ = (
+    (
+        "type",
+        OneOf(
+            (1, Preprepare),
+            (2, Prepare),
+            (3, Commit),
+            (4, Checkpoint),
+            (5, Suspect),
+            (6, EpochChange),
+            (7, EpochChangeAck),
+            (8, NewEpoch),
+            (9, NewEpochEcho),
+            (10, NewEpochReady),
+            (11, FetchBatch),
+            (12, ForwardBatch),
+            (13, FetchRequest),
+            (14, ForwardRequest),
+            (15, RequestAck),
+        ),
+    ),
+)
+_ALL_MESSAGES.extend([NewEpochEcho, NewEpochReady])
+
+
+def encode(msg) -> bytes:
+    return wire.encode(msg)
+
+
+def decode(cls, buf: bytes):
+    return wire.decode(cls, buf)

@@ -1,0 +1,255 @@
+"""Deterministic binary codec for the schema layer.
+
+The reference serializes everything with protobuf (reference:
+mirbftpb/mirbft.proto).  This framework is not wire-compatible with the Go
+implementation; instead it defines its own *canonical* encoding with the one
+property the whole test methodology depends on: encoding is a pure function of
+the message value (no maps, no presence-dependent field skipping, no varint
+malleability accepted on decode).  Every event log, WAL entry, and hash
+preimage in the framework goes through this module, which is what makes runs
+recordable and replayable bit-for-bit (reference: docs/StateMachine.md, the
+determinism discipline).
+
+Messages declare an explicit ``_spec_``: a tuple of (field_name, FieldType)
+pairs, encoded in declaration order.  Supported field types are built from:
+
+- ``U64`` / ``U32`` / ``I32`` — unsigned LEB128 varints (I32 values must be
+  non-negative; the reference only uses non-negative int32s).
+- ``BOOL`` — one byte, 0 or 1.
+- ``BYTES`` — varint length + raw bytes.
+- ``Nested(cls)`` — optional nested message: presence byte, then varint
+  length + body.  ``None`` encodes as a single 0 byte.
+- ``Rep(ft)`` — repeated field: varint count + encoded items.
+- ``OneOf((tag, cls), ...)`` — tagged union: varint tag (0 = unset) +
+  varint length + body.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import fields as dc_fields
+from typing import Any
+
+
+def encode_varint(value: int) -> bytes:
+    if value < 0:
+        raise ValueError(f"varint must be non-negative, got {value}")
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            # Reject non-canonical (over-long) encodings so that
+            # encode(decode(x)) == x for every accepted input.
+            if b == 0 and shift != 0:
+                raise ValueError("non-canonical varint")
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+class FieldType:
+    def encode(self, out: io.BytesIO, value: Any) -> None:
+        raise NotImplementedError
+
+    def decode(self, buf: bytes, pos: int) -> tuple[Any, int]:
+        raise NotImplementedError
+
+
+class _UInt(FieldType):
+    def __init__(self, bits: int):
+        self.bits = bits
+
+    def encode(self, out, value):
+        if value is None:
+            value = 0
+        if value >> self.bits:
+            raise ValueError(f"value {value} exceeds {self.bits} bits")
+        out.write(encode_varint(int(value)))
+
+    def decode(self, buf, pos):
+        v, pos = decode_varint(buf, pos)
+        if v >> self.bits:
+            raise ValueError(f"decoded value {v} exceeds {self.bits} bits")
+        return v, pos
+
+
+U64 = _UInt(64)
+U32 = _UInt(32)
+I32 = _UInt(31)  # non-negative int32s only (matches all reference uses)
+
+
+class _Bool(FieldType):
+    def encode(self, out, value):
+        out.write(b"\x01" if value else b"\x00")
+
+    def decode(self, buf, pos):
+        if pos >= len(buf):
+            raise ValueError("truncated bool")
+        b = buf[pos]
+        if b > 1:
+            raise ValueError("non-canonical bool")
+        return bool(b), pos + 1
+
+
+BOOL = _Bool()
+
+
+class _Bytes(FieldType):
+    def encode(self, out, value):
+        if value is None:
+            value = b""
+        out.write(encode_varint(len(value)))
+        out.write(value)
+
+    def decode(self, buf, pos):
+        n, pos = decode_varint(buf, pos)
+        if pos + n > len(buf):
+            raise ValueError("truncated bytes")
+        return buf[pos : pos + n], pos + n
+
+
+BYTES = _Bytes()
+
+
+class Nested(FieldType):
+    """Optional nested message (None allowed)."""
+
+    def __init__(self, cls):
+        self.cls = cls
+
+    def encode(self, out, value):
+        if value is None:
+            out.write(b"\x00")
+            return
+        body = encode(value)
+        out.write(b"\x01")
+        out.write(encode_varint(len(body)))
+        out.write(body)
+
+    def decode(self, buf, pos):
+        if pos >= len(buf):
+            raise ValueError("truncated nested presence byte")
+        present = buf[pos]
+        pos += 1
+        if present == 0:
+            return None, pos
+        if present != 1:
+            raise ValueError("non-canonical presence byte")
+        n, pos = decode_varint(buf, pos)
+        if pos + n > len(buf):
+            raise ValueError("truncated nested message")
+        return decode(self.cls, buf[pos : pos + n]), pos + n
+
+
+class Rep(FieldType):
+    def __init__(self, item: FieldType):
+        self.item = item
+
+    def encode(self, out, value):
+        if value is None:
+            value = ()
+        out.write(encode_varint(len(value)))
+        for v in value:
+            self.item.encode(out, v)
+
+    def decode(self, buf, pos):
+        n, pos = decode_varint(buf, pos)
+        items = []
+        for _ in range(n):
+            v, pos = self.item.decode(buf, pos)
+            items.append(v)
+        return items, pos
+
+
+class OneOf(FieldType):
+    """Tagged union over message classes.  Value is an instance of one of the
+    registered classes, or None (tag 0)."""
+
+    def __init__(self, *entries: tuple[int, type]):
+        self.by_tag = {}
+        self.by_cls = {}
+        for tag, cls in entries:
+            if tag <= 0:
+                raise ValueError("oneof tags must be positive")
+            if tag in self.by_tag or cls in self.by_cls:
+                raise ValueError("duplicate oneof entry")
+            self.by_tag[tag] = cls
+            self.by_cls[cls] = tag
+
+    def encode(self, out, value):
+        if value is None:
+            out.write(b"\x00")
+            return
+        tag = self.by_cls.get(type(value))
+        if tag is None:
+            raise TypeError(
+                f"{type(value).__name__} is not a member of this oneof"
+            )
+        body = encode(value)
+        out.write(encode_varint(tag))
+        out.write(encode_varint(len(body)))
+        out.write(body)
+
+    def decode(self, buf, pos):
+        tag, pos = decode_varint(buf, pos)
+        if tag == 0:
+            return None, pos
+        cls = self.by_tag.get(tag)
+        if cls is None:
+            raise ValueError(f"unknown oneof tag {tag}")
+        n, pos = decode_varint(buf, pos)
+        if pos + n > len(buf):
+            raise ValueError("truncated oneof body")
+        return decode(cls, buf[pos : pos + n]), pos + n
+
+
+def _spec_of(cls) -> tuple:
+    spec = getattr(cls, "_spec_", None)
+    if spec is None:
+        raise TypeError(f"{cls.__name__} has no _spec_")
+    return spec
+
+
+def encode(msg) -> bytes:
+    out = io.BytesIO()
+    for name, ft in _spec_of(type(msg)):
+        ft.encode(out, getattr(msg, name))
+    return out.getvalue()
+
+
+def decode(cls, buf: bytes):
+    values = {}
+    pos = 0
+    for name, ft in _spec_of(cls):
+        values[name], pos = ft.decode(buf, pos)
+    if pos != len(buf):
+        raise ValueError(f"{cls.__name__}: {len(buf) - pos} trailing bytes")
+    return cls(**values)
+
+
+def check_spec(cls) -> None:
+    """Assert the _spec_ names exactly match the dataclass fields, in order."""
+    spec_names = [n for n, _ in _spec_of(cls)]
+    field_names = [f.name for f in dc_fields(cls)]
+    if spec_names != field_names:
+        raise TypeError(
+            f"{cls.__name__}: spec fields {spec_names} != dataclass fields {field_names}"
+        )
