@@ -223,6 +223,23 @@ class ForwardRequest:
 
 
 @dataclass
+class NewEpochEcho:
+    """Bracha echo of a NewEpochConfig.  The reference reuses NewEpochConfig
+    for both the echo (tag 9) and ready (tag 10) arms of the Msg oneof
+    (mirbft.proto:203-204); explicit wrapper types keep step routing
+    unambiguous."""
+
+    new_epoch_config: NewEpochConfig | None = None
+
+
+@dataclass
+class NewEpochReady:
+    """Bracha ready of a NewEpochConfig (see NewEpochEcho)."""
+
+    new_epoch_config: NewEpochConfig | None = None
+
+
+@dataclass
 class Msg:
     """The wire-message oneof: 15 types (mirbft.proto:193-211)."""
 
@@ -456,6 +473,7 @@ Reconfiguration._spec_ = (
             (1, ReconfigNewClient),
             (2, ReconfigRemoveClient),
             (3, NetworkConfig),
+            allow_unset=False,
         ),
     ),
 )
@@ -522,6 +540,8 @@ ForwardRequest._spec_ = (
     ("request_data", BYTES),
 )
 
+NewEpochEcho._spec_ = (("new_epoch_config", Nested(NewEpochConfig)),)
+NewEpochReady._spec_ = (("new_epoch_config", Nested(NewEpochConfig)),)
 Msg._spec_ = (
     (
         "type",
@@ -534,12 +554,14 @@ Msg._spec_ = (
             (6, EpochChange),
             (7, EpochChangeAck),
             (8, NewEpoch),
-            (9, NewEpochConfig),  # new_epoch_echo — see msg wrappers below
+            (9, NewEpochEcho),
+            (10, NewEpochReady),
             (11, FetchBatch),
             (12, ForwardBatch),
             (13, FetchRequest),
             (14, ForwardRequest),
             (15, RequestAck),
+            allow_unset=False,
         ),
     ),
 )
@@ -571,6 +593,7 @@ Persistent._spec_ = (
             (6, ECEntry),
             (7, TEntry),
             (8, Suspect),
+            allow_unset=False,
         ),
     ),
 )
@@ -653,6 +676,7 @@ StateEvent._spec_ = (
             (8, EventStep),
             (9, EventTick),
             (10, EventActionsReceived),
+            allow_unset=False,
         ),
     ),
 )
@@ -674,6 +698,8 @@ _ALL_MESSAGES = [
     EpochChangeAck,
     RemoteEpochChange,
     NewEpoch,
+    NewEpochEcho,
+    NewEpochReady,
     Preprepare,
     Prepare,
     Commit,
@@ -714,55 +740,6 @@ _ALL_MESSAGES = [
 
 for _cls in _ALL_MESSAGES:
     wire.check_spec(_cls)
-
-
-# ---------------------------------------------------------------------------
-# Msg wrappers.  The Msg oneof reuses NewEpochConfig for both echo (tag 9) and
-# ready (tag 10) in the reference (mirbft.proto:203-204), and RequestAck for
-# both fetch_request (13) and request_ack (15).  We disambiguate echo/ready
-# with an explicit wrapper and fetch/ack with the distinct FetchRequest class.
-# ---------------------------------------------------------------------------
-
-
-@dataclass
-class NewEpochEcho:
-    new_epoch_config: NewEpochConfig | None = None
-
-
-@dataclass
-class NewEpochReady:
-    new_epoch_config: NewEpochConfig | None = None
-
-
-NewEpochEcho._spec_ = (("new_epoch_config", Nested(NewEpochConfig)),)
-NewEpochReady._spec_ = (("new_epoch_config", Nested(NewEpochConfig)),)
-wire.check_spec(NewEpochEcho)
-wire.check_spec(NewEpochReady)
-
-# Rebuild the Msg oneof with the explicit echo/ready wrappers.
-Msg._spec_ = (
-    (
-        "type",
-        OneOf(
-            (1, Preprepare),
-            (2, Prepare),
-            (3, Commit),
-            (4, Checkpoint),
-            (5, Suspect),
-            (6, EpochChange),
-            (7, EpochChangeAck),
-            (8, NewEpoch),
-            (9, NewEpochEcho),
-            (10, NewEpochReady),
-            (11, FetchBatch),
-            (12, ForwardBatch),
-            (13, FetchRequest),
-            (14, ForwardRequest),
-            (15, RequestAck),
-        ),
-    ),
-)
-_ALL_MESSAGES.extend([NewEpochEcho, NewEpochReady])
 
 
 def encode(msg) -> bytes:

@@ -20,8 +20,10 @@ pairs, encoded in declaration order.  Supported field types are built from:
 - ``Nested(cls)`` — optional nested message: presence byte, then varint
   length + body.  ``None`` encodes as a single 0 byte.
 - ``Rep(ft)`` — repeated field: varint count + encoded items.
-- ``OneOf((tag, cls), ...)`` — tagged union: varint tag (0 = unset) +
-  varint length + body.
+- ``OneOf((tag, cls), ...)`` — tagged union: varint tag + varint length +
+  body.  Tag 0 means unset, accepted only when ``allow_unset`` (the default);
+  oneofs where an empty value is never legitimate (Msg, Persistent,
+  StateEvent, Reconfiguration) set ``allow_unset=False`` and reject it.
 """
 
 from __future__ import annotations
@@ -34,6 +36,8 @@ from typing import Any
 def encode_varint(value: int) -> bytes:
     if value < 0:
         raise ValueError(f"varint must be non-negative, got {value}")
+    if value >> 64:
+        raise ValueError(f"varint exceeds 64 bits: {value}")
     out = bytearray()
     while True:
         b = value & 0x7F
@@ -59,6 +63,11 @@ def decode_varint(buf: bytes, pos: int) -> tuple[int, int]:
             # encode(decode(x)) == x for every accepted input.
             if b == 0 and shift != 0:
                 raise ValueError("non-canonical varint")
+            # A 10th byte may only contribute bit 63: the decodable set must
+            # equal the encodable set (values < 2^64) at every position,
+            # including raw length/count/tag positions.
+            if shift == 63 and b > 1:
+                raise ValueError("varint exceeds 64 bits")
             return result, pos
         shift += 7
         if shift > 63:
@@ -181,9 +190,16 @@ class Rep(FieldType):
 
 class OneOf(FieldType):
     """Tagged union over message classes.  Value is an instance of one of the
-    registered classes, or None (tag 0)."""
+    registered classes, or None (tag 0, only when ``allow_unset``).
 
-    def __init__(self, *entries: tuple[int, type]):
+    ``allow_unset=False`` makes tag 0 a decode error and None an encode
+    error; used for oneofs where an empty value is never legitimate (wire
+    messages, WAL entries, state events) so that malformed input is rejected
+    at the codec boundary rather than deep inside the state machine.
+    """
+
+    def __init__(self, *entries: tuple[int, type], allow_unset: bool = True):
+        self.allow_unset = allow_unset
         self.by_tag = {}
         self.by_cls = {}
         for tag, cls in entries:
@@ -196,6 +212,8 @@ class OneOf(FieldType):
 
     def encode(self, out, value):
         if value is None:
+            if not self.allow_unset:
+                raise ValueError("oneof value must be set")
             out.write(b"\x00")
             return
         tag = self.by_cls.get(type(value))
@@ -211,6 +229,8 @@ class OneOf(FieldType):
     def decode(self, buf, pos):
         tag, pos = decode_varint(buf, pos)
         if tag == 0:
+            if not self.allow_unset:
+                raise ValueError("oneof value must be set")
             return None, pos
         cls = self.by_tag.get(tag)
         if cls is None:

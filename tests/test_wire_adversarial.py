@@ -1,0 +1,123 @@
+"""Adversarial codec gate.
+
+The canonical-encoding property the framework's replay/test methodology rests
+on (see mirbft_tpu/wire.py docstring) is: the set of accepted encodings is
+exactly the set of produced encodings.  These probes attack that property:
+truncation at every prefix, single-bit flips (any accepted mutation must
+re-encode byte-identically), non-canonical presence/bool/varint forms,
+unknown oneof tags, and length/count claims exceeding the buffer or the
+64-bit value space.
+"""
+
+import pytest
+
+from mirbft_tpu import pb, wire
+from tests.test_wire import SAMPLES
+
+
+def _ids(s):
+    if hasattr(s, "type") and s.type is not None:
+        return type(s.type).__name__
+    return type(s).__name__
+
+
+@pytest.mark.parametrize("sample", SAMPLES, ids=_ids)
+def test_every_strict_prefix_rejected(sample):
+    enc = pb.encode(sample)
+    for cut in range(len(enc)):
+        with pytest.raises(ValueError):
+            pb.decode(type(sample), enc[:cut])
+
+
+@pytest.mark.parametrize("sample", SAMPLES, ids=_ids)
+def test_accepted_bit_flips_are_canonical(sample):
+    """Flipping any single bit either fails to decode or decodes to a value
+    whose canonical encoding is byte-identical to the mutated buffer — i.e.
+    no mutation lands in accepted-but-non-canonical territory."""
+    enc = pb.encode(sample)
+    cls = type(sample)
+    for byte_i in range(len(enc)):
+        for bit in range(8):
+            mutated = bytearray(enc)
+            mutated[byte_i] ^= 1 << bit
+            mutated = bytes(mutated)
+            try:
+                dec = pb.decode(cls, mutated)
+            except (ValueError, TypeError):
+                continue
+            assert pb.encode(dec) == mutated, (
+                f"byte {byte_i} bit {bit}: accepted non-canonical mutation"
+            )
+
+
+def test_unknown_oneof_tag_rejected():
+    # Persistent oneof has tags 1..8; tag 9 with an empty body must fail.
+    with pytest.raises(ValueError):
+        pb.decode(pb.Persistent, b"\x09\x00")
+
+
+def test_unset_oneof_rejected_for_critical_oneofs():
+    # Tag 0 (unset) is never legitimate for wire msgs, WAL entries, events,
+    # or reconfigurations.
+    for cls in (pb.Msg, pb.Persistent, pb.StateEvent, pb.Reconfiguration):
+        with pytest.raises(ValueError):
+            pb.decode(cls, b"\x00")
+        with pytest.raises(ValueError):
+            pb.encode(cls())
+
+
+def test_presence_byte_above_one_rejected():
+    # EventLoadRequest: presence byte for the nested RequestAck.
+    good = pb.encode(pb.EventLoadRequest(request_ack=pb.RequestAck(digest=b"d")))
+    assert good[0] == 1
+    bad = b"\x02" + good[1:]
+    with pytest.raises(ValueError):
+        pb.decode(pb.EventLoadRequest, bad)
+
+
+def test_bool_byte_above_one_rejected():
+    ns = pb.NetworkState(config=pb.NetworkConfig(nodes=[0]), reconfigured=True)
+    enc = pb.encode(ns)
+    assert enc[-1] == 1  # reconfigured bool is the final byte
+    with pytest.raises(ValueError):
+        pb.decode(pb.NetworkState, enc[:-1] + b"\x02")
+
+
+def test_huge_length_claim_rejected():
+    # bytes field claiming 2^32 bytes with a 1-byte body.
+    claim = wire.encode_varint(2**32)
+    with pytest.raises(ValueError):
+        pb.decode(pb.RequestAck, b"\x01\x01" + claim + b"x")
+
+
+def test_huge_count_claim_rejected():
+    # NetworkConfig.nodes (repeated) claiming 2^40 items then ending.
+    with pytest.raises(ValueError):
+        pb.decode(pb.NetworkConfig, wire.encode_varint(2**40))
+
+
+def test_varint_above_64_bits_rejected_everywhere():
+    # 2^64 exactly: 10 bytes, final byte 0x02.  Must be rejected even at raw
+    # length/count/tag positions where no typed range check applies.
+    overflow = b"\x80" * 9 + b"\x02"
+    v_max = b"\xff" * 9 + b"\x01"
+    assert wire.decode_varint(v_max, 0)[0] == 2**64 - 1
+    with pytest.raises(ValueError):
+        wire.decode_varint(overflow, 0)
+    # At a length position (RequestAck.digest).
+    with pytest.raises(ValueError):
+        pb.decode(pb.RequestAck, b"\x01\x01" + overflow)
+    # At a count position (NetworkConfig.nodes).
+    with pytest.raises(ValueError):
+        pb.decode(pb.NetworkConfig, overflow)
+    # At a oneof-tag position.
+    with pytest.raises(ValueError):
+        pb.decode(pb.Msg, overflow)
+
+
+def test_wrong_class_decode_rejected():
+    # A Prepare encoding fed to Commit decodes fine (same shape) — but a
+    # Prepare fed to NetworkState must fail somewhere in the field walk.
+    enc = pb.encode(pb.Prepare(seq_no=1, epoch=2, digest=b"\xff" * 32))
+    with pytest.raises((ValueError, TypeError)):
+        pb.decode(pb.NetworkState, enc)
