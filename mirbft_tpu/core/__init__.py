@@ -1,0 +1,8 @@
+"""Protocol core: the single-threaded, deterministic Mir state machine.
+
+Everything in this package is pure, I/O-free, clock-free logic — the rebuild
+of the reference's L1 layer (reference: docs/StateMachine.md, the determinism
+discipline).  All compute (hashing, signature verification) is *requested*
+via the Actions contract in ``actions`` and executed by the runtime/TPU
+compute plane, never performed here.
+"""

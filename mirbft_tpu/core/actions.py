@@ -1,0 +1,215 @@
+"""The Actions/Results contract: the complete work-order vocabulary between
+the deterministic protocol core and the executor (runtime / TPU compute
+plane).
+
+Rebuild of the reference's consumer contract (reference: actions.go:18-261).
+The state machine emits an ``Actions`` value from every applied event; the
+executor performs the work — persist, send, hash (on TPU), commit — and
+feeds ``ActionResults`` back in as a state event.  This seam is what lets
+the hot crypto be batched and dispatched to the accelerator without the
+protocol core ever touching a device.
+
+Safety ordering contract for executors (reference: docs/Processor.md:24-28):
+requests stored and WAL writes fsynced *before* any network send; hashing is
+order-free; commits independent of persistence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import pb
+
+
+@dataclass
+class WalAppend:
+    index: int
+    data: pb.Persistent
+
+
+@dataclass
+class WalWrite:
+    """Exactly one of truncate/append is set (reference: actions.go:128-137).
+    ``truncate`` removes every entry with index below the given value."""
+
+    truncate: int | None = None
+    append: WalAppend | None = None
+
+
+@dataclass
+class Send:
+    targets: list  # node IDs, including self
+    msg: pb.Msg
+
+
+@dataclass
+class Forward:
+    """Like Send, but the executor must first fetch the request data from its
+    request store and wrap it in a ForwardRequest message."""
+
+    targets: list
+    request_ack: pb.RequestAck
+
+
+@dataclass
+class HashRequest:
+    """A digest the executor must compute: SHA-256 over the concatenation of
+    ``data`` chunks (layouts in core.preimage).  ``origin`` is a pb.HashResult
+    with an empty digest and a populated type; the executor fills in the
+    digest and returns the completed pb.HashResult."""
+
+    data: list  # [bytes]
+    origin: pb.HashResult
+
+
+@dataclass
+class CheckpointReq:
+    """A request for the application to compute a checkpoint value over its
+    state at seq_no (reference: actions.go:181-205).  The value must be a
+    pure function of the application state + network state — NOT the epoch —
+    since different nodes may commit the same checkpoint in different
+    epochs."""
+
+    seq_no: int
+    network_config: pb.NetworkConfig
+    clients_state: list  # [pb.NetworkClient]
+
+
+@dataclass
+class CommitAction:
+    """Either a totally-ordered batch to apply, or a checkpoint request.
+    Exactly one is set."""
+
+    batch: pb.QEntry | None = None
+    checkpoint: CheckpointReq | None = None
+
+
+@dataclass
+class StateTarget:
+    seq_no: int
+    value: bytes
+
+
+@dataclass
+class Actions:
+    sends: list = field(default_factory=list)  # [Send]
+    hashes: list = field(default_factory=list)  # [HashRequest]
+    write_ahead: list = field(default_factory=list)  # [WalWrite]
+    commits: list = field(default_factory=list)  # [CommitAction]
+    store_requests: list = field(default_factory=list)  # [pb.ForwardRequest]
+    forward_requests: list = field(default_factory=list)  # [Forward]
+    state_transfer: StateTarget | None = None
+
+    def send(self, targets: list, msg: pb.Msg) -> "Actions":
+        self.sends.append(Send(targets=list(targets), msg=msg))
+        return self
+
+    def hash(self, data: list, origin: pb.HashResult) -> "Actions":
+        self.hashes.append(HashRequest(data=data, origin=origin))
+        return self
+
+    def persist(self, index: int, entry: pb.Persistent) -> "Actions":
+        self.write_ahead.append(
+            WalWrite(append=WalAppend(index=index, data=entry))
+        )
+        return self
+
+    def truncate(self, index: int) -> "Actions":
+        self.write_ahead.append(WalWrite(truncate=index))
+        return self
+
+    def store_request(self, request: pb.ForwardRequest) -> "Actions":
+        self.store_requests.append(request)
+        return self
+
+    def forward_request(self, targets: list, ack: pb.RequestAck) -> "Actions":
+        self.forward_requests.append(
+            Forward(targets=list(targets), request_ack=ack)
+        )
+        return self
+
+    def is_empty(self) -> bool:
+        return (
+            not self.sends
+            and not self.hashes
+            and not self.write_ahead
+            and not self.commits
+            and not self.store_requests
+            and not self.forward_requests
+            and self.state_transfer is None
+        )
+
+    def clear(self) -> None:
+        self.sends = []
+        self.hashes = []
+        self.write_ahead = []
+        self.commits = []
+        self.store_requests = []
+        self.forward_requests = []
+        self.state_transfer = None
+
+    def concat(self, other: "Actions") -> "Actions":
+        self.sends.extend(other.sends)
+        self.hashes.extend(other.hashes)
+        self.write_ahead.extend(other.write_ahead)
+        self.commits.extend(other.commits)
+        self.store_requests.extend(other.store_requests)
+        self.forward_requests.extend(other.forward_requests)
+        if other.state_transfer is not None:
+            if self.state_transfer is not None:
+                raise AssertionError(
+                    "two concurrent state transfer requests"
+                )
+            self.state_transfer = other.state_transfer
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Results (reference: actions.go:216-261).  The runtime converts these to the
+# wire-level pb.HashResult / pb.CheckpointResult carried by the AddResults
+# state event (reference: mirbft.go:391-421).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class HashResult:
+    digest: bytes
+    request: HashRequest
+
+
+@dataclass
+class CheckpointResult:
+    checkpoint: CheckpointReq
+    value: bytes
+    # Ordered reconfigurations that committed within this checkpoint window;
+    # applied starting at the *next* checkpoint.
+    reconfigurations: list = field(default_factory=list)  # [pb.Reconfiguration]
+
+
+@dataclass
+class ActionResults:
+    digests: list = field(default_factory=list)  # [HashResult]
+    checkpoints: list = field(default_factory=list)  # [CheckpointResult]
+
+
+def results_to_event(results: ActionResults) -> pb.EventActionResults:
+    """Convert runtime-level results into the serializable state event
+    (reference: mirbft.go:392-413)."""
+    digests = []
+    for hr in results.digests:
+        origin = hr.request.origin
+        digests.append(pb.HashResult(digest=hr.digest, type=origin.type))
+    checkpoints = []
+    for cr in results.checkpoints:
+        checkpoints.append(
+            pb.CheckpointResult(
+                seq_no=cr.checkpoint.seq_no,
+                value=cr.value,
+                network_state=pb.NetworkState(
+                    config=cr.checkpoint.network_config,
+                    clients=cr.checkpoint.clients_state,
+                    pending_reconfigurations=list(cr.reconfigurations),
+                ),
+            )
+        )
+    return pb.EventActionResults(digests=digests, checkpoints=checkpoints)

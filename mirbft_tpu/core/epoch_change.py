@@ -1,0 +1,101 @@
+"""Parsing and ACK-certification of EpochChange messages.
+
+Rebuild of the reference's epoch-change bookkeeping (reference:
+epoch_change.go:18-116).  An EpochChange travels the network alongside
+hash-attested ACKs (EpochChangeAck); a strong certificate (intersection
+quorum of ACKs on one digest) is what lets the new epoch's leader safely
+include it in a NewEpoch message.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import pb
+from .quorum import intersection_quorum
+
+
+class MalformedEpochChange(ValueError):
+    pass
+
+
+@dataclass
+class ParsedEpochChange:
+    """A structurally validated EpochChange with its pSet/qSet indexed for
+    the new-view computation (quorum.construct_new_epoch_config)."""
+
+    underlying: pb.EpochChange
+    low_watermark: int
+    # seq_no -> pb.EpochChangeSetEntry (at most one prepared digest per seq)
+    p_set: dict = field(default_factory=dict)
+    # seq_no -> {epoch -> digest} (one preprepared digest per (seq, epoch))
+    q_set: dict = field(default_factory=dict)
+    # node IDs that ACKed this exact epoch-change digest
+    acks: set = field(default_factory=set)
+
+
+def parse_epoch_change(underlying: pb.EpochChange) -> ParsedEpochChange:
+    if not underlying.checkpoints:
+        raise MalformedEpochChange("epoch change contains no checkpoints")
+
+    seen_checkpoints = set()
+    low_watermark = underlying.checkpoints[0].seq_no
+    for checkpoint in underlying.checkpoints:
+        if checkpoint.seq_no in seen_checkpoints:
+            raise MalformedEpochChange(
+                f"duplicate checkpoint seq_no {checkpoint.seq_no}"
+            )
+        seen_checkpoints.add(checkpoint.seq_no)
+        low_watermark = min(low_watermark, checkpoint.seq_no)
+
+    p_set = {}
+    for entry in underlying.p_set:
+        if entry.seq_no in p_set:
+            raise MalformedEpochChange(
+                f"duplicate pSet entry for seq_no {entry.seq_no}"
+            )
+        p_set[entry.seq_no] = entry
+
+    q_set = {}
+    for entry in underlying.q_set:
+        epochs = q_set.setdefault(entry.seq_no, {})
+        if entry.epoch in epochs:
+            raise MalformedEpochChange(
+                f"duplicate qSet entry for seq_no {entry.seq_no} "
+                f"epoch {entry.epoch}"
+            )
+        epochs[entry.epoch] = entry.digest
+
+    return ParsedEpochChange(
+        underlying=underlying,
+        low_watermark=low_watermark,
+        p_set=p_set,
+        q_set=q_set,
+    )
+
+
+@dataclass
+class EpochChangeCert:
+    """Collects (digest, msg) variants of one node's EpochChange and the ACKs
+    for each, promoting the first digest to reach an intersection quorum to
+    ``strong_cert`` (reference: epoch_change.go:29-52)."""
+
+    network_config: pb.NetworkConfig
+    parsed_by_digest: dict = field(default_factory=dict)  # digest -> ParsedEpochChange
+    strong_cert: bytes | None = None
+
+    def add_msg(self, source: int, msg: pb.EpochChange, digest: bytes) -> None:
+        parsed = self.parsed_by_digest.get(digest)
+        if parsed is None:
+            try:
+                parsed = parse_epoch_change(msg)
+            except MalformedEpochChange:
+                return
+            self.parsed_by_digest[digest] = parsed
+
+        parsed.acks.add(source)
+
+        if self.strong_cert is None and len(parsed.acks) >= intersection_quorum(
+            self.network_config
+        ):
+            self.strong_cert = digest

@@ -1,0 +1,197 @@
+"""In-memory mirror of the durable write-ahead log.
+
+Rebuild of the reference's persisted log (reference: persisted.go:15-317).
+Appends emit persist actions for the executor's durable WAL; on restart the
+runtime replays the durable log back in via ``append_initial_load``.  The
+log's entry grammar doubles as the source from which epoch-change messages
+are deterministically *recomputed* rather than persisted (reference:
+docs/WALMovement.md:59-61) — see ``construct_epoch_change``.
+
+Truncation discipline (reference: persisted.go:152-184, docs/WALMovement.md):
+the log may only be truncated to a CEntry at-or-above the low watermark, or
+to an NEntry above it, and never while an epoch change is in flight (the
+ECEntry pins the tail, enforced by callers simply not calling truncate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import pb
+from .actions import Actions
+
+
+@dataclass
+class _LogEntry:
+    index: int
+    entry: pb.Persistent
+
+
+class Persisted:
+    def __init__(self, logger=None):
+        self._log: list[_LogEntry] = []  # always index-contiguous
+        self._head = 0  # offset of the logical head within _log
+        self.next_index = 0
+        self.logger = logger
+
+    # -- startup loading ----------------------------------------------------
+
+    def append_initial_load(self, index: int, entry: pb.Persistent) -> None:
+        has_entries = len(self._log) > self._head
+        if has_entries and self.next_index != index:
+            raise ValueError(
+                f"WAL indexes out of order: expected {self.next_index}, "
+                f"got {index} — corrupted WAL?"
+            )
+        self._log.append(_LogEntry(index=index, entry=entry))
+        self.next_index = index + 1
+
+    # -- appends (emit persist actions) -------------------------------------
+
+    def _append(self, entry: pb.Persistent) -> Actions:
+        self._log.append(_LogEntry(index=self.next_index, entry=entry))
+        actions = Actions().persist(self.next_index, entry)
+        self.next_index += 1
+        return actions
+
+    def add_q_entry(self, q_entry: pb.QEntry) -> Actions:
+        return self._append(pb.Persistent(type=q_entry))
+
+    def add_p_entry(self, p_entry: pb.PEntry) -> Actions:
+        return self._append(pb.Persistent(type=p_entry))
+
+    def add_c_entry(self, c_entry: pb.CEntry) -> Actions:
+        if c_entry.network_state is None:
+            raise AssertionError("CEntry requires network state")
+        return self._append(pb.Persistent(type=c_entry))
+
+    def add_n_entry(self, n_entry: pb.NEntry) -> Actions:
+        return self._append(pb.Persistent(type=n_entry))
+
+    def add_f_entry(self, f_entry: pb.FEntry) -> Actions:
+        return self._append(pb.Persistent(type=f_entry))
+
+    def add_ec_entry(self, ec_entry: pb.ECEntry) -> Actions:
+        return self._append(pb.Persistent(type=ec_entry))
+
+    def add_t_entry(self, t_entry: pb.TEntry) -> Actions:
+        return self._append(pb.Persistent(type=t_entry))
+
+    def add_suspect(self, suspect: pb.Suspect) -> Actions:
+        return self._append(pb.Persistent(type=suspect))
+
+    # -- truncation ---------------------------------------------------------
+
+    def truncate(self, low_watermark: int) -> Actions:
+        """Truncate the head to the first CEntry with seq_no >= low_watermark
+        or NEntry with seq_no > low_watermark (reference: persisted.go:152-184)."""
+        for offset in range(self._head, len(self._log)):
+            entry = self._log[offset].entry.type
+            if isinstance(entry, pb.CEntry):
+                if entry.seq_no < low_watermark:
+                    continue
+            elif isinstance(entry, pb.NEntry):
+                if entry.seq_no <= low_watermark:
+                    continue
+            else:
+                continue
+
+            if offset == self._head:
+                break
+
+            self._head = offset
+            # Compact occasionally so memory stays bounded without churning
+            # the list on every truncate.
+            if self._head > 4096:
+                del self._log[: self._head]
+                self._head = 0
+            return Actions().truncate(self._log[self._head].index)
+
+        return Actions()
+
+    # -- iteration ----------------------------------------------------------
+
+    def entries(self):
+        """Iterate (index, pb.Persistent) from the logical head."""
+        for le in self._log[self._head :]:
+            yield le.index, le.entry
+
+    def iterate(self, handlers: dict, should_exit=None) -> None:
+        """Dispatch each live entry to handlers[type(entry)] if present
+        (reference: persisted.go:198-242)."""
+        for _, persistent in self.entries():
+            handler = handlers.get(type(persistent.type))
+            if handler is not None:
+                handler(persistent.type)
+            if should_exit is not None and should_exit():
+                break
+
+    # -- deterministic epoch-change reconstruction --------------------------
+
+    def construct_epoch_change(self, new_epoch: int) -> pb.EpochChange:
+        """Recompute the EpochChange message for new_epoch from the log
+        (reference: persisted.go:244-317).
+
+        Entries are scoped to the epoch of the preceding NEntry/FEntry; the
+        scan stops once the log's epoch reaches new_epoch.  The pSet keeps
+        only the *last* PEntry per seq_no (a sequence re-prepared in a later
+        epoch supersedes the earlier prepare); the qSet keeps every QEntry
+        (one per (seq, epoch) by construction); checkpoints collect every
+        CEntry seen."""
+        checkpoints: list[pb.Checkpoint] = []
+        # seq_no -> (epoch, digest); later entries overwrite earlier ones,
+        # implementing the reference's two-pass "skip all but last" dedup in
+        # a single pass.  p_order tracks *last*-occurrence order, matching
+        # where the reference's second pass emits the surviving entry.
+        p_latest: dict[int, tuple[int, bytes]] = {}
+        p_order: list[int] = []
+        q_set: list[pb.EpochChangeSetEntry] = []
+
+        log_epoch: int | None = None
+        for _, persistent in self.entries():
+            if log_epoch is not None and log_epoch >= new_epoch:
+                break
+            entry = persistent.type
+            if isinstance(entry, pb.NEntry):
+                log_epoch = entry.epoch_config.number
+            elif isinstance(entry, pb.FEntry):
+                log_epoch = entry.ends_epoch_config.number
+            elif isinstance(entry, pb.PEntry):
+                if log_epoch is None:
+                    raise ValueError(
+                        f"PEntry for seq_no {entry.seq_no} precedes any "
+                        f"NEntry/FEntry epoch marker — corrupt log"
+                    )
+                if entry.seq_no in p_latest:
+                    p_order.remove(entry.seq_no)
+                p_order.append(entry.seq_no)
+                p_latest[entry.seq_no] = (log_epoch, entry.digest)
+            elif isinstance(entry, pb.QEntry):
+                if log_epoch is None:
+                    raise ValueError(
+                        f"QEntry for seq_no {entry.seq_no} precedes any "
+                        f"NEntry/FEntry epoch marker — corrupt log"
+                    )
+                q_set.append(
+                    pb.EpochChangeSetEntry(
+                        epoch=log_epoch, seq_no=entry.seq_no, digest=entry.digest
+                    )
+                )
+            elif isinstance(entry, pb.CEntry):
+                checkpoints.append(
+                    pb.Checkpoint(seq_no=entry.seq_no, value=entry.checkpoint_value)
+                )
+
+        p_set = [
+            pb.EpochChangeSetEntry(
+                epoch=p_latest[seq][0], seq_no=seq, digest=p_latest[seq][1]
+            )
+            for seq in p_order
+        ]
+
+        return pb.EpochChange(
+            new_epoch=new_epoch,
+            checkpoints=checkpoints,
+            p_set=p_set,
+            q_set=q_set,
+        )

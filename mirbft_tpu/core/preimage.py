@@ -1,0 +1,66 @@
+"""Hash-preimage byte layouts: the contract between the protocol core and
+the TPU digest kernels.
+
+Every digest in the protocol is SHA-256 over the concatenation of a list of
+byte chunks.  The chunk layouts here are the canonical formats every node
+must agree on — a digest computed on TPU (ops.sha256) and one computed with
+hashlib must be bit-identical for the same logical value.
+
+Layouts (reference equivalents):
+- request:       [u64le(client_id), u64le(req_no), data]
+                 (reference: state_machine.go:313-317)
+- batch:         [ack_digest, ...] one chunk per request ack
+                 (reference: sequence.go:154-157)
+- epoch change:  [u64le(new_epoch)] + per checkpoint [u64le(seq_no), value]
+                 + per pSet entry [u64le(epoch), u64le(seq_no), digest]
+                 + per qSet entry [u64le(epoch), u64le(seq_no), digest]
+                 (reference: stateless.go:311-340)
+
+Integers are 8-byte little-endian (reference: proposer.go:16-20).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .. import pb
+
+
+def u64le(value: int) -> bytes:
+    return value.to_bytes(8, "little")
+
+
+def request_hash_data(request: pb.Request) -> list:
+    return [u64le(request.client_id), u64le(request.req_no), request.data]
+
+
+def batch_hash_data(request_acks: list) -> list:
+    return [ack.digest for ack in request_acks]
+
+
+def epoch_change_hash_data(epoch_change: pb.EpochChange) -> list:
+    chunks = [u64le(epoch_change.new_epoch)]
+    for cp in epoch_change.checkpoints:
+        chunks.append(u64le(cp.seq_no))
+        chunks.append(cp.value)
+    for entry in epoch_change.p_set:
+        chunks.append(u64le(entry.epoch))
+        chunks.append(u64le(entry.seq_no))
+        chunks.append(entry.digest)
+    for entry in epoch_change.q_set:
+        chunks.append(u64le(entry.epoch))
+        chunks.append(u64le(entry.seq_no))
+        chunks.append(entry.digest)
+    return chunks
+
+
+def host_digest(chunks: list) -> bytes:
+    """Reference SHA-256 over concatenated chunks, computed on the host.
+
+    This is the correctness oracle for the TPU kernel (ops.sha256) and the
+    digest path for tiny/latency-sensitive work not worth a device round
+    trip."""
+    h = hashlib.sha256()
+    for chunk in chunks:
+        h.update(chunk)
+    return h.digest()

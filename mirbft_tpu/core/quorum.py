@@ -1,0 +1,211 @@
+"""Pure protocol math: quorum sizes, committed bitmasks, bucket partitioning,
+and the PBFT new-view selection function.
+
+Rebuild of the reference's stateless helpers (reference: stateless.go:18-309).
+Everything here is a pure function; determinism rules (docs/StateMachine.md)
+are enforced by iterating node sets in config order and sorting any
+dict-derived iteration.
+"""
+
+from __future__ import annotations
+
+from .. import pb
+
+
+# ---------------------------------------------------------------------------
+# Quorum math (reference: stateless.go:90-101)
+# ---------------------------------------------------------------------------
+
+
+def intersection_quorum(config: pb.NetworkConfig) -> int:
+    """Number of nodes such that any two such sets intersect in a correct
+    node: ceil((n+f+1)/2)."""
+    return (len(config.nodes) + config.f + 2) // 2
+
+
+def some_correct_quorum(config: pb.NetworkConfig) -> int:
+    """Number of nodes such that at least one is correct: f+1."""
+    return config.f + 1
+
+
+# ---------------------------------------------------------------------------
+# Bucket partitioning (reference: stateless.go:103-109)
+# ---------------------------------------------------------------------------
+
+
+def client_req_to_bucket(client_id: int, req_no: int, config: pb.NetworkConfig) -> int:
+    return (client_id + req_no) % config.number_of_buckets
+
+
+def seq_to_bucket(seq_no: int, config: pb.NetworkConfig) -> int:
+    return seq_no % config.number_of_buckets
+
+
+# ---------------------------------------------------------------------------
+# Committed bitmask (reference: stateless.go:18-88)
+#
+# MSB-first within each byte: bit 0 of the mask is 0x80 of byte 0.  This is
+# the format of NetworkClient.committed_mask, so it is part of the
+# checkpoint-value contract.
+# ---------------------------------------------------------------------------
+
+
+def make_bitmask(n_bits: int) -> bytearray:
+    return bytearray((n_bits + 7) // 8)
+
+
+def bit_is_set(mask: bytes, bit_index: int) -> bool:
+    byte_index = bit_index // 8
+    if byte_index >= len(mask):
+        return False
+    return bool(mask[byte_index] & (0x80 >> (bit_index % 8)))
+
+
+def set_bit(mask: bytearray, bit_index: int) -> None:
+    byte_index = bit_index // 8
+    if byte_index >= len(mask):
+        raise IndexError(
+            f"bit {bit_index} out of range for {len(mask)}-byte mask"
+        )
+    mask[byte_index] |= 0x80 >> (bit_index % 8)
+
+
+# ---------------------------------------------------------------------------
+# New-epoch config selection (reference: stateless.go:111-309)
+#
+# The PBFT new-view computation, adapted to Mir: pick the highest checkpoint
+# supported by f+1 nodes and reachable by an intersection quorum, then for
+# every in-flight sequence above it select a digest by condition A (an
+# intersection quorum agrees via their pSets, backed by f+1 qSet entries) or
+# condition B (an intersection quorum never prepared it → null request).
+# Returns None when neither condition can yet be satisfied (must wait for
+# more epoch-change messages).
+# ---------------------------------------------------------------------------
+
+
+class DivergentCheckpointError(Exception):
+    """Two f+1-supported quorums hold different values for the same seq_no —
+    the byzantine assumption (f < n/3) has been exceeded."""
+
+
+def construct_new_epoch_config(
+    config: pb.NetworkConfig,
+    new_leaders: list,
+    epoch_changes: dict,
+) -> pb.NewEpochConfig | None:
+    """epoch_changes maps node_id -> parsed epoch change (an object with
+    ``underlying`` (pb.EpochChange), ``low_watermark`` (int), ``p_set``
+    (dict seq_no -> pb.EpochChangeSetEntry), and ``q_set`` (dict seq_no ->
+    dict epoch -> digest)); see core.epoch_change.ParsedEpochChange."""
+
+    # Tally checkpoint support in deterministic node order.
+    checkpoint_support: dict[tuple[int, bytes], list] = {}
+    new_epoch_number = 0
+    for node_id in config.nodes:
+        change = epoch_changes.get(node_id)
+        if change is None:
+            continue
+        new_epoch_number = change.underlying.new_epoch
+        for checkpoint in change.underlying.checkpoints:
+            key = (checkpoint.seq_no, checkpoint.value)
+            checkpoint_support.setdefault(key, []).append(node_id)
+
+    # ordered_changes: deterministic iteration for the commutative counts.
+    ordered_changes = [epoch_changes[k] for k in sorted(epoch_changes)]
+
+    max_checkpoint: tuple[int, bytes] | None = None
+    for key in sorted(checkpoint_support, key=lambda k: (k[0], k[1])):
+        supporters = checkpoint_support[key]
+        if len(supporters) < some_correct_quorum(config):
+            continue
+        reachable = sum(
+            1 for change in ordered_changes if change.low_watermark <= key[0]
+        )
+        if reachable < intersection_quorum(config):
+            continue
+        if max_checkpoint is not None and max_checkpoint[0] == key[0]:
+            raise DivergentCheckpointError(
+                f"two correct quorums hold different checkpoints for seq_no "
+                f"{key[0]}: {max_checkpoint[1]!r} != {key[1]!r}"
+            )
+        if max_checkpoint is None or key[0] > max_checkpoint[0]:
+            max_checkpoint = key
+
+    if max_checkpoint is None:
+        return None
+
+    start_seq, start_value = max_checkpoint
+
+    final_preprepares: list[bytes] = [b""] * (2 * config.checkpoint_interval)
+    any_selected = False
+
+    for offset in range(len(final_preprepares)):
+        seq_no = start_seq + offset + 1
+
+        selected_digest: bytes | None = None
+        for node_id in config.nodes:
+            change = epoch_changes.get(node_id)
+            if change is None:
+                continue
+            entry = change.p_set.get(seq_no)
+            if entry is None:
+                continue
+
+            # Condition A1: an intersection quorum either never prepared
+            # seq_no at an epoch >= this entry's, or prepared this digest.
+            a1 = 0
+            for other in ordered_changes:
+                if other.low_watermark >= seq_no:
+                    continue
+                other_entry = other.p_set.get(seq_no)
+                if other_entry is None or other_entry.epoch < entry.epoch:
+                    a1 += 1
+                elif other_entry.epoch == entry.epoch and other_entry.digest == entry.digest:
+                    a1 += 1
+            if a1 < intersection_quorum(config):
+                continue
+
+            # Condition A2: f+1 nodes preprepared this digest at an
+            # epoch >= the entry's epoch.
+            a2 = 0
+            for other in ordered_changes:
+                epoch_digests = other.q_set.get(seq_no)
+                if not epoch_digests:
+                    continue
+                for epoch, digest in epoch_digests.items():
+                    if epoch >= entry.epoch and digest == entry.digest:
+                        a2 += 1
+                        break
+            if a2 < some_correct_quorum(config):
+                continue
+
+            selected_digest = entry.digest
+            break
+
+        if selected_digest is not None:
+            final_preprepares[offset] = selected_digest
+            any_selected = True
+            continue
+
+        # Condition B: an intersection quorum (of nodes whose logs cover
+        # seq_no) never prepared anything there → safe to null it.
+        b_count = sum(
+            1
+            for other in ordered_changes
+            if other.low_watermark < seq_no and seq_no not in other.p_set
+        )
+        if b_count < intersection_quorum(config):
+            return None  # cannot satisfy A or B yet; wait for more changes
+
+    if not any_selected:
+        final_preprepares = []
+
+    return pb.NewEpochConfig(
+        config=pb.EpochConfig(
+            number=new_epoch_number,
+            leaders=list(new_leaders),
+            planned_expiration=start_seq + config.max_epoch_length,
+        ),
+        starting_checkpoint=pb.Checkpoint(seq_no=start_seq, value=start_value),
+        final_preprepares=final_preprepares,
+    )

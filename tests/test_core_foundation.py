@@ -1,0 +1,300 @@
+"""Gates for core.preimage (golden byte layouts), core.actions (contract
+algebra), core.persisted (log mirror, truncation, epoch-change
+reconstruction), and core.epoch_change (parsing + certs)."""
+
+import hashlib
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core import actions as act
+from mirbft_tpu.core import preimage
+from mirbft_tpu.core.epoch_change import (
+    EpochChangeCert,
+    MalformedEpochChange,
+    parse_epoch_change,
+)
+from mirbft_tpu.core.persisted import Persisted
+
+
+# ---------------------------------------------------------------------------
+# preimage: golden layouts
+# ---------------------------------------------------------------------------
+
+
+def test_request_preimage_golden():
+    req = pb.Request(client_id=1, req_no=0x0102, data=b"payload")
+    chunks = preimage.request_hash_data(req)
+    assert chunks == [
+        b"\x01\x00\x00\x00\x00\x00\x00\x00",
+        b"\x02\x01\x00\x00\x00\x00\x00\x00",
+        b"payload",
+    ]
+    assert preimage.host_digest(chunks) == hashlib.sha256(
+        b"".join(chunks)
+    ).digest()
+
+
+def test_batch_preimage_is_ack_digest_concat():
+    acks = [
+        pb.RequestAck(client_id=1, req_no=1, digest=b"\xaa" * 32),
+        pb.RequestAck(client_id=2, req_no=9, digest=b"\xbb" * 32),
+    ]
+    assert preimage.batch_hash_data(acks) == [b"\xaa" * 32, b"\xbb" * 32]
+
+
+def test_epoch_change_preimage_golden():
+    ec = pb.EpochChange(
+        new_epoch=3,
+        checkpoints=[pb.Checkpoint(seq_no=20, value=b"v")],
+        p_set=[pb.EpochChangeSetEntry(epoch=2, seq_no=21, digest=b"p")],
+        q_set=[pb.EpochChangeSetEntry(epoch=2, seq_no=22, digest=b"q")],
+    )
+    chunks = preimage.epoch_change_hash_data(ec)
+    assert chunks == [
+        preimage.u64le(3),
+        preimage.u64le(20),
+        b"v",
+        preimage.u64le(2),
+        preimage.u64le(21),
+        b"p",
+        preimage.u64le(2),
+        preimage.u64le(22),
+        b"q",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# actions algebra
+# ---------------------------------------------------------------------------
+
+
+def test_actions_concat_clear_empty():
+    a = act.Actions()
+    assert a.is_empty()
+    a.send([0, 1], pb.Msg(type=pb.Suspect(epoch=1)))
+    a.persist(0, pb.Persistent(type=pb.ECEntry(epoch_number=1)))
+    b = act.Actions()
+    b.hash([b"x"], pb.HashResult(digest=b"", type=pb.HashOriginBatch()))
+    b.state_transfer = act.StateTarget(seq_no=5, value=b"v")
+    a.concat(b)
+    assert len(a.sends) == 1 and len(a.write_ahead) == 1 and len(a.hashes) == 1
+    assert a.state_transfer is not None
+    assert not a.is_empty()
+    # Two concurrent state transfers must be rejected.
+    c = act.Actions()
+    c.state_transfer = act.StateTarget(seq_no=6, value=b"w")
+    with pytest.raises(AssertionError):
+        a.concat(c)
+    a.clear()
+    assert a.is_empty()
+
+
+def test_results_to_event_copies_digest_into_origin():
+    origin = pb.HashResult(
+        digest=b"",
+        type=pb.HashOriginBatch(source=0, epoch=0, seq_no=5, request_acks=[]),
+    )
+    hr = act.HashResult(
+        digest=b"\x01" * 32, request=act.HashRequest(data=[b"d"], origin=origin)
+    )
+    cr = act.CheckpointResult(
+        checkpoint=act.CheckpointReq(
+            seq_no=20,
+            network_config=pb.NetworkConfig(nodes=[0], number_of_buckets=1),
+            clients_state=[pb.NetworkClient(id=1, width=10)],
+        ),
+        value=b"cpv",
+        reconfigurations=[],
+    )
+    event = act.results_to_event(
+        act.ActionResults(digests=[hr], checkpoints=[cr])
+    )
+    assert event.digests[0].digest == b"\x01" * 32
+    assert isinstance(event.digests[0].type, pb.HashOriginBatch)
+    assert event.checkpoints[0].seq_no == 20
+    assert event.checkpoints[0].value == b"cpv"
+    assert event.checkpoints[0].network_state.clients[0].id == 1
+
+
+# ---------------------------------------------------------------------------
+# persisted log
+# ---------------------------------------------------------------------------
+
+
+def _centry(seq, value=b"cp", n=4):
+    return pb.Persistent(
+        type=pb.CEntry(
+            seq_no=seq,
+            checkpoint_value=value,
+            network_state=pb.NetworkState(
+                config=pb.NetworkConfig(nodes=list(range(n)), number_of_buckets=n)
+            ),
+        )
+    )
+
+
+def _nentry(seq, epoch):
+    return pb.Persistent(
+        type=pb.NEntry(
+            seq_no=seq,
+            epoch_config=pb.EpochConfig(number=epoch, leaders=[0]),
+        )
+    )
+
+
+def test_persisted_append_emits_persist_actions_with_increasing_indexes():
+    p = Persisted()
+    a1 = p.add_c_entry(_centry(0).type)
+    a2 = p.add_p_entry(pb.PEntry(seq_no=1, digest=b"d"))
+    assert a1.write_ahead[0].append.index == 0
+    assert a2.write_ahead[0].append.index == 1
+    assert p.next_index == 2
+
+
+def test_persisted_initial_load_checks_contiguity():
+    p = Persisted()
+    p.append_initial_load(5, _centry(0))
+    p.append_initial_load(6, _nentry(1, 0))
+    assert p.next_index == 7
+    with pytest.raises(ValueError):
+        p.append_initial_load(9, _nentry(2, 0))
+
+
+def test_persisted_truncate_to_centry():
+    p = Persisted()
+    p.add_c_entry(_centry(0).type)
+    p.add_n_entry(_nentry(1, 0).type)
+    p.add_q_entry(pb.QEntry(seq_no=1, digest=b"d1"))
+    p.add_c_entry(_centry(20).type)
+    p.add_q_entry(pb.QEntry(seq_no=21, digest=b"d21"))
+
+    actions = p.truncate(20)
+    # Truncates to the index of the CEntry(20): index 3.
+    assert len(actions.write_ahead) == 1
+    assert actions.write_ahead[0].truncate == 3
+    kinds = [type(e.type).__name__ for _, e in p.entries()]
+    assert kinds == ["CEntry", "QEntry"]
+    # Truncating again to the same watermark is a no-op.
+    assert p.truncate(20).is_empty()
+
+
+def test_persisted_truncate_nentry_rule():
+    # NEntry requires seq_no strictly greater than the watermark.
+    p = Persisted()
+    p.add_c_entry(_centry(0).type)
+    p.add_n_entry(_nentry(20, 0).type)  # NEntry at exactly the watermark: skip
+    p.add_n_entry(_nentry(21, 0).type)
+    actions = p.truncate(20)
+    assert actions.write_ahead[0].truncate == 2
+
+
+def test_construct_epoch_change_basic():
+    p = Persisted()
+    p.add_c_entry(_centry(0, b"genesis").type)
+    p.add_n_entry(_nentry(1, 0).type)
+    p.add_q_entry(pb.QEntry(seq_no=1, digest=b"q1"))
+    p.add_p_entry(pb.PEntry(seq_no=1, digest=b"q1"))
+    p.add_c_entry(_centry(5, b"cp5").type)
+
+    ec = p.construct_epoch_change(1)
+    assert ec.new_epoch == 1
+    assert [(c.seq_no, c.value) for c in ec.checkpoints] == [
+        (0, b"genesis"),
+        (5, b"cp5"),
+    ]
+    assert [(e.epoch, e.seq_no, e.digest) for e in ec.p_set] == [(0, 1, b"q1")]
+    assert [(e.epoch, e.seq_no, e.digest) for e in ec.q_set] == [(0, 1, b"q1")]
+
+
+def test_construct_epoch_change_dedups_pset_keeping_last():
+    p = Persisted()
+    p.add_c_entry(_centry(0).type)
+    p.add_n_entry(_nentry(1, 0).type)
+    p.add_p_entry(pb.PEntry(seq_no=1, digest=b"old"))
+    p.add_n_entry(_nentry(1, 1).type)  # epoch 1 starts
+    p.add_p_entry(pb.PEntry(seq_no=1, digest=b"new"))
+
+    ec = p.construct_epoch_change(2)
+    assert [(e.epoch, e.seq_no, e.digest) for e in ec.p_set] == [(1, 1, b"new")]
+
+
+def test_construct_epoch_change_stops_at_new_epoch():
+    p = Persisted()
+    p.add_c_entry(_centry(0).type)
+    p.add_n_entry(_nentry(1, 0).type)
+    p.add_q_entry(pb.QEntry(seq_no=1, digest=b"in-epoch-0"))
+    p.add_n_entry(_nentry(6, 3).type)  # jumps to epoch 3 >= target 2
+    p.add_q_entry(pb.QEntry(seq_no=6, digest=b"in-epoch-3"))
+
+    ec = p.construct_epoch_change(2)
+    digests = [e.digest for e in ec.q_set]
+    assert digests == [b"in-epoch-0"]
+
+
+# ---------------------------------------------------------------------------
+# epoch change parsing + certs
+# ---------------------------------------------------------------------------
+
+
+def test_parse_epoch_change_rejects_malformed():
+    with pytest.raises(MalformedEpochChange):
+        parse_epoch_change(pb.EpochChange(new_epoch=1))  # no checkpoints
+    with pytest.raises(MalformedEpochChange):
+        parse_epoch_change(
+            pb.EpochChange(
+                new_epoch=1,
+                checkpoints=[
+                    pb.Checkpoint(seq_no=5, value=b"a"),
+                    pb.Checkpoint(seq_no=5, value=b"b"),
+                ],
+            )
+        )
+    with pytest.raises(MalformedEpochChange):
+        parse_epoch_change(
+            pb.EpochChange(
+                new_epoch=1,
+                checkpoints=[pb.Checkpoint(seq_no=5, value=b"a")],
+                p_set=[
+                    pb.EpochChangeSetEntry(epoch=0, seq_no=6, digest=b"x"),
+                    pb.EpochChangeSetEntry(epoch=0, seq_no=6, digest=b"y"),
+                ],
+            )
+        )
+
+
+def test_parse_epoch_change_low_watermark_is_min_checkpoint():
+    parsed = parse_epoch_change(
+        pb.EpochChange(
+            new_epoch=1,
+            checkpoints=[
+                pb.Checkpoint(seq_no=25, value=b"b"),
+                pb.Checkpoint(seq_no=20, value=b"a"),
+            ],
+            q_set=[
+                pb.EpochChangeSetEntry(epoch=0, seq_no=21, digest=b"x"),
+                pb.EpochChangeSetEntry(epoch=1, seq_no=21, digest=b"y"),
+            ],
+        )
+    )
+    assert parsed.low_watermark == 20
+    assert parsed.q_set[21] == {0: b"x", 1: b"y"}
+
+
+def test_epoch_change_cert_strong_cert_at_intersection_quorum():
+    nc = pb.NetworkConfig(nodes=[0, 1, 2, 3], f=1, number_of_buckets=4)
+    ec_msg = pb.EpochChange(
+        new_epoch=1, checkpoints=[pb.Checkpoint(seq_no=0, value=b"g")]
+    )
+    cert = EpochChangeCert(network_config=nc)
+    cert.add_msg(0, ec_msg, b"digest")
+    cert.add_msg(1, ec_msg, b"digest")
+    assert cert.strong_cert is None
+    cert.add_msg(1, ec_msg, b"digest")  # duplicate ack: no change
+    assert cert.strong_cert is None
+    cert.add_msg(2, ec_msg, b"digest")
+    assert cert.strong_cert == b"digest"
+    # Malformed variants are ignored entirely.
+    cert2 = EpochChangeCert(network_config=nc)
+    cert2.add_msg(0, pb.EpochChange(new_epoch=1), b"bad")
+    assert cert2.parsed_by_digest == {}

@@ -1,0 +1,168 @@
+"""Gate for core.quorum: quorum math, bitmask, buckets, new-view selection
+(reference behaviors: stateless.go:18-309)."""
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core import quorum
+from mirbft_tpu.core.epoch_change import parse_epoch_change
+
+
+def config(n=4, f=1, buckets=4, ci=5, max_epoch_len=50):
+    return pb.NetworkConfig(
+        nodes=list(range(n)),
+        f=f,
+        number_of_buckets=buckets,
+        checkpoint_interval=ci,
+        max_epoch_length=max_epoch_len,
+    )
+
+
+def test_quorum_sizes():
+    # (n + f + 2) // 2 == ceil((n+f+1)/2)
+    assert quorum.intersection_quorum(config(4, 1)) == 3
+    assert quorum.some_correct_quorum(config(4, 1)) == 2
+    assert quorum.intersection_quorum(config(1, 0)) == 1
+    assert quorum.some_correct_quorum(config(1, 0)) == 1
+    assert quorum.intersection_quorum(config(7, 2)) == 5
+    assert quorum.intersection_quorum(config(10, 3)) == 7
+
+
+def test_bucket_mapping():
+    nc = config(buckets=4)
+    assert quorum.seq_to_bucket(0, nc) == 0
+    assert quorum.seq_to_bucket(7, nc) == 3
+    assert quorum.client_req_to_bucket(2, 3, nc) == 1
+    # Consecutive reqs from one client rotate through buckets.
+    buckets = [quorum.client_req_to_bucket(9, r, nc) for r in range(4)]
+    assert sorted(buckets) == [0, 1, 2, 3]
+
+
+def test_bitmask_msb_first():
+    mask = quorum.make_bitmask(12)
+    assert len(mask) == 2
+    quorum.set_bit(mask, 0)
+    assert bytes(mask) == b"\x80\x00"
+    quorum.set_bit(mask, 7)
+    assert bytes(mask) == b"\x81\x00"
+    quorum.set_bit(mask, 8)
+    assert bytes(mask) == b"\x81\x80"
+    assert quorum.bit_is_set(mask, 0)
+    assert not quorum.bit_is_set(mask, 1)
+    assert quorum.bit_is_set(mask, 8)
+    # Out-of-range reads are False, writes raise.
+    assert not quorum.bit_is_set(mask, 100)
+    with pytest.raises(IndexError):
+        quorum.set_bit(mask, 16)
+
+
+# ---------------------------------------------------------------------------
+# construct_new_epoch_config
+# ---------------------------------------------------------------------------
+
+
+def _ec(new_epoch, checkpoints, p_set=(), q_set=()):
+    return parse_epoch_change(
+        pb.EpochChange(
+            new_epoch=new_epoch,
+            checkpoints=[pb.Checkpoint(seq_no=s, value=v) for s, v in checkpoints],
+            p_set=[
+                pb.EpochChangeSetEntry(epoch=e, seq_no=s, digest=d)
+                for e, s, d in p_set
+            ],
+            q_set=[
+                pb.EpochChangeSetEntry(epoch=e, seq_no=s, digest=d)
+                for e, s, d in q_set
+            ],
+        )
+    )
+
+
+def test_new_epoch_config_idle_network():
+    """All nodes at the same checkpoint with nothing in flight → config
+    starts there with no final preprepares."""
+    nc = config(4, 1, ci=5, max_epoch_len=50)
+    changes = {i: _ec(1, [(20, b"cp20")]) for i in range(4)}
+    result = quorum.construct_new_epoch_config(nc, [0, 1, 2, 3], changes)
+    assert result is not None
+    assert result.config.number == 1
+    assert result.config.leaders == [0, 1, 2, 3]
+    assert result.config.planned_expiration == 20 + 50
+    assert result.starting_checkpoint == pb.Checkpoint(seq_no=20, value=b"cp20")
+    assert result.final_preprepares == []
+
+
+def test_new_epoch_config_insufficient_changes():
+    nc = config(4, 1)
+    changes = {0: _ec(1, [(20, b"cp20")])}  # only 1 of 4; need 3 reachable
+    assert quorum.construct_new_epoch_config(nc, [0], changes) is None
+
+
+def test_new_epoch_config_selects_highest_supported_checkpoint():
+    nc = config(4, 1, ci=5, max_epoch_len=50)
+    changes = {
+        0: _ec(1, [(20, b"cp20"), (25, b"cp25")]),
+        1: _ec(1, [(20, b"cp20"), (25, b"cp25")]),
+        2: _ec(1, [(20, b"cp20")]),
+        3: _ec(1, [(20, b"cp20")]),
+    }
+    result = quorum.construct_new_epoch_config(nc, [0, 1, 2, 3], changes)
+    # 25 has f+1=2 supporters and all low watermarks are 20 <= 25.
+    assert result.starting_checkpoint.seq_no == 25
+
+
+def test_new_epoch_config_condition_a_selects_prepared_digest():
+    nc = config(4, 1, ci=5, max_epoch_len=50)
+    d = b"\xaa" * 32
+    # Three nodes prepared seq 21 digest d in epoch 0; they also preprepared
+    # it (qSet).  Fourth node is silent.
+    changes = {
+        i: _ec(1, [(20, b"cp")], p_set=[(0, 21, d)], q_set=[(0, 21, d)])
+        for i in range(3)
+    }
+    result = quorum.construct_new_epoch_config(nc, [0, 1, 2, 3], changes)
+    assert result is not None
+    assert len(result.final_preprepares) == 2 * nc.checkpoint_interval
+    assert result.final_preprepares[0] == d  # seq 21 = offset 0
+    assert all(fp == b"" for fp in result.final_preprepares[1:])
+
+
+def test_new_epoch_config_condition_b_nulls_unprepared():
+    nc = config(4, 1, ci=5, max_epoch_len=50)
+    # Nobody prepared anything: every in-flight slot nulls out.
+    changes = {i: _ec(1, [(20, b"cp")]) for i in range(3)}
+    result = quorum.construct_new_epoch_config(nc, [0, 1, 2, 3], changes)
+    assert result is not None
+    assert result.final_preprepares == []
+
+
+def test_new_epoch_config_waits_when_a_and_b_unsatisfiable():
+    nc = config(4, 1, ci=5, max_epoch_len=50)
+    d = b"\xbb" * 32
+    # One node prepared seq 21; without qSet backing (a2 < f+1) condition A
+    # fails, and with only 3 changes condition B (needs 3 without the entry,
+    # but node 0 has it) counts 2 < 3 → must wait.
+    changes = {i: _ec(1, [(20, b"cp")]) for i in range(1, 3)}
+    changes[0] = _ec(1, [(20, b"cp")], p_set=[(0, 21, d)])
+    assert quorum.construct_new_epoch_config(nc, [0, 1, 2, 3], changes) is None
+
+
+def test_new_epoch_config_divergent_checkpoints_raise():
+    nc = config(4, 1)
+    changes = {
+        0: _ec(1, [(20, b"value-A")]),
+        1: _ec(1, [(20, b"value-A")]),
+        2: _ec(1, [(20, b"value-B")]),
+        3: _ec(1, [(20, b"value-B")]),
+    }
+    with pytest.raises(quorum.DivergentCheckpointError):
+        quorum.construct_new_epoch_config(nc, [0, 1, 2, 3], changes)
+
+
+def test_new_epoch_config_single_node_network():
+    nc = config(1, 0, buckets=1, ci=1, max_epoch_len=10)
+    changes = {0: _ec(1, [(0, b"genesis")])}
+    result = quorum.construct_new_epoch_config(nc, [0], changes)
+    assert result is not None
+    assert result.starting_checkpoint.seq_no == 0
+    assert result.config.planned_expiration == 10

@@ -1,0 +1,201 @@
+"""Gate for the sequence 3-phase FSM: a live port of the reference's disabled
+spec (reference: sequence_test.go:39-281) with exact expected Actions, plus
+full happy paths for owner and follower roles on a 4-node f=1 network."""
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core.persisted import Persisted
+from mirbft_tpu.core.sequence import Sequence, SeqState
+
+
+NODES = [0, 1, 2, 3]
+
+
+def make_seq(my_id=1, owner=0, epoch=4, seq_no=5):
+    nc = pb.NetworkConfig(nodes=list(NODES), f=1, number_of_buckets=4)
+    persisted = Persisted()
+    return Sequence(
+        owner=owner,
+        epoch=epoch,
+        seq_no=seq_no,
+        persisted=persisted,
+        network_config=nc,
+        my_config=pb.InitialParameters(id=my_id),
+    )
+
+
+ACKS = [
+    pb.RequestAck(client_id=9, req_no=7, digest=b"msg1-digest"),
+    pb.RequestAck(client_id=9, req_no=8, digest=b"msg2-digest"),
+]
+
+
+def test_allocate_emits_batch_hash_request():
+    s = make_seq()
+    actions = s.allocate(list(ACKS), None)
+    assert len(actions.hashes) == 1
+    hr = actions.hashes[0]
+    assert hr.data == [b"msg1-digest", b"msg2-digest"]
+    assert hr.origin.digest == b""
+    origin = hr.origin.type
+    assert isinstance(origin, pb.HashOriginBatch)
+    assert origin.source == 0 and origin.seq_no == 5 and origin.epoch == 4
+    assert origin.request_acks == ACKS
+    assert not actions.sends and not actions.write_ahead
+    # PENDING_REQUESTS advances immediately to READY with no outstanding reqs.
+    assert s.state == SeqState.READY
+    assert s.batch == ACKS
+
+
+def test_allocate_twice_raises():
+    s = make_seq()
+    s.allocate(list(ACKS), None)
+    with pytest.raises(AssertionError):
+        s.allocate(list(ACKS), None)
+
+
+def test_follower_hash_result_sends_prepare_and_persists_qentry():
+    s = make_seq(my_id=1, owner=0)
+    s.allocate(list(ACKS), None)
+    actions = s.apply_batch_hash_result(b"digest")
+
+    assert s.state == SeqState.PREPREPARED
+    assert s.digest == b"digest"
+    assert s.q_entry == pb.QEntry(seq_no=5, digest=b"digest", requests=ACKS)
+
+    [send] = actions.sends
+    assert send.targets == NODES
+    assert send.msg == pb.Msg(
+        type=pb.Prepare(seq_no=5, epoch=4, digest=b"digest")
+    )
+    [write] = actions.write_ahead
+    assert write.append.data == pb.Persistent(
+        type=pb.QEntry(seq_no=5, digest=b"digest", requests=ACKS)
+    )
+
+
+def test_owner_hash_result_sends_preprepare():
+    s = make_seq(my_id=0, owner=0)
+
+    class CR:
+        def __init__(self, ack, agreements):
+            self.ack = ack
+            self.agreements = agreements
+
+    # Node 3 hasn't ACKed msg2: it must receive a forward.
+    crs = [CR(ACKS[0], {0, 1, 2, 3}), CR(ACKS[1], {0, 1, 2})]
+    s.allocate_as_owner(crs)
+    actions = s.apply_batch_hash_result(b"digest")
+
+    [send] = actions.sends
+    assert send.msg == pb.Msg(
+        type=pb.Preprepare(seq_no=5, epoch=4, batch=ACKS)
+    )
+    assert [(f.targets, f.request_ack) for f in actions.forward_requests] == [
+        ([], ACKS[0]),
+        ([3], ACKS[1]),
+    ]
+
+
+def test_prepare_quorum_sends_commit_and_persists_pentry():
+    s = make_seq(my_id=1, owner=0)
+    s.allocate(list(ACKS), None)
+    # The owner's preprepare counts as its prepare (count 1).
+    s.apply_batch_hash_result(b"digest")
+    # Our own Prepare was broadcast to all nodes *including self*; the
+    # executor loops it back (count 2, and unlocks the own-vote gate).
+    s.apply_prepare_msg(1, b"digest")
+    actions = s.apply_prepare_msg(2, b"digest")  # 3rd prepare → quorum
+
+    assert s.state == SeqState.PREPARED
+    [send] = actions.sends
+    assert send.msg == pb.Msg(
+        type=pb.Commit(seq_no=5, epoch=4, digest=b"digest")
+    )
+    [write] = actions.write_ahead
+    assert write.append.data == pb.Persistent(
+        type=pb.PEntry(seq_no=5, digest=b"digest")
+    )
+
+
+def test_wrong_digest_prepares_do_not_count():
+    s = make_seq(my_id=1, owner=0)
+    s.allocate(list(ACKS), None)
+    s.apply_batch_hash_result(b"digest")
+    s.apply_prepare_msg(1, b"digest")
+    s.apply_prepare_msg(2, b"evil")
+    s.apply_prepare_msg(3, b"evil")
+    assert s.state == SeqState.PREPREPARED  # no quorum on our digest
+
+
+def test_equivocating_prepare_ignored():
+    s = make_seq(my_id=1, owner=0)
+    s.allocate(list(ACKS), None)
+    s.apply_batch_hash_result(b"digest")
+    s.apply_prepare_msg(2, b"digest")
+    # Node 2 equivocates with a second prepare: ignored.
+    s.apply_prepare_msg(2, b"digest")
+    assert s._prepares[b"digest"] == 2  # owner + node 2, not 3
+    assert s.state == SeqState.PREPREPARED
+
+
+def test_full_happy_path_to_committed():
+    s = make_seq(my_id=1, owner=0)
+    s.allocate(list(ACKS), None)
+    s.apply_batch_hash_result(b"digest")  # owner's implicit prepare
+    s.apply_prepare_msg(1, b"digest")  # own prepare, self-delivered
+    s.apply_prepare_msg(2, b"digest")  # quorum → Commit sent
+    assert s.state == SeqState.PREPARED
+    s.apply_commit_msg(1, b"digest")  # own commit, self-delivered
+    s.apply_commit_msg(0, b"digest")
+    actions = s.apply_commit_msg(2, b"digest")
+    assert s.state == SeqState.COMMITTED
+    assert actions.is_empty()
+
+
+def test_commit_quorum_requires_own_commit():
+    s = make_seq(my_id=1, owner=0)
+    s.allocate(list(ACKS), None)
+    s.apply_batch_hash_result(b"digest")
+    s.apply_prepare_msg(1, b"digest")
+    s.apply_prepare_msg(2, b"digest")
+    assert s.state == SeqState.PREPARED
+    # Three remote commits but not our own: must not commit.
+    s.apply_commit_msg(0, b"digest")
+    s.apply_commit_msg(2, b"digest")
+    s.apply_commit_msg(3, b"digest")
+    assert s.state == SeqState.PREPARED
+    s.apply_commit_msg(1, b"digest")
+    assert s.state == SeqState.COMMITTED
+
+
+def test_null_batch_skips_hash():
+    s = make_seq(my_id=1, owner=0)
+    actions = s.allocate([], None)
+    # No hash request; a Prepare with empty digest and a QEntry are emitted.
+    assert not actions.hashes
+    assert s.state == SeqState.PREPREPARED
+    [send] = actions.sends
+    assert send.msg == pb.Msg(type=pb.Prepare(seq_no=5, epoch=4, digest=b""))
+    assert s.q_entry == pb.QEntry(seq_no=5, digest=b"", requests=[])
+
+
+def test_outstanding_requests_gate_readiness():
+    s = make_seq(my_id=1, owner=0)
+    outstanding = {b"msg2-digest"}
+    actions = s.allocate(list(ACKS), outstanding)
+    assert len(actions.hashes) == 1
+    assert s.state == SeqState.PENDING_REQUESTS
+
+    # Digest arrives while a request is still missing: stays pending.
+    actions = s.apply_batch_hash_result(b"digest")
+    assert s.state == SeqState.PENDING_REQUESTS
+    assert not actions.sends
+
+    actions = s.satisfy_outstanding(ACKS[1])
+    assert s.state == SeqState.PREPREPARED
+    [send] = actions.sends
+    assert send.msg == pb.Msg(
+        type=pb.Prepare(seq_no=5, epoch=4, digest=b"digest")
+    )

@@ -255,7 +255,13 @@ SAMPLES = [
 ]
 
 
-@pytest.mark.parametrize("sample", SAMPLES, ids=lambda s: type(s.type).__name__ if hasattr(s, "type") and s.type is not None else type(s).__name__)
+def sample_id(s):
+    if hasattr(s, "type") and s.type is not None:
+        return type(s.type).__name__
+    return type(s).__name__
+
+
+@pytest.mark.parametrize("sample", SAMPLES, ids=sample_id)
 def test_roundtrip_all(sample):
     enc = pb.encode(sample)
     dec = pb.decode(type(sample), enc)

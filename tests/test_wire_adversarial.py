@@ -12,16 +12,10 @@ unknown oneof tags, and length/count claims exceeding the buffer or the
 import pytest
 
 from mirbft_tpu import pb, wire
-from tests.test_wire import SAMPLES
+from tests.test_wire import SAMPLES, sample_id
 
 
-def _ids(s):
-    if hasattr(s, "type") and s.type is not None:
-        return type(s.type).__name__
-    return type(s).__name__
-
-
-@pytest.mark.parametrize("sample", SAMPLES, ids=_ids)
+@pytest.mark.parametrize("sample", SAMPLES, ids=sample_id)
 def test_every_strict_prefix_rejected(sample):
     enc = pb.encode(sample)
     for cut in range(len(enc)):
@@ -29,7 +23,7 @@ def test_every_strict_prefix_rejected(sample):
             pb.decode(type(sample), enc[:cut])
 
 
-@pytest.mark.parametrize("sample", SAMPLES, ids=_ids)
+@pytest.mark.parametrize("sample", SAMPLES, ids=sample_id)
 def test_accepted_bit_flips_are_canonical(sample):
     """Flipping any single bit either fails to decode or decodes to a value
     whose canonical encoding is byte-identical to the mutated buffer — i.e.
