@@ -1,0 +1,235 @@
+"""Checkpoint agreement tracking.
+
+Rebuild of the reference's checkpoint tracker (reference:
+checkpoints.go:19-319).  Value-agreement rules per checkpoint seq_no:
+
+- f+1 nodes on one value → the network committed it (``committed_value``);
+- our own value plus an intersection quorum on the committed value →
+  ``stable``: watermarks may slide, the WAL may truncate, trackers GC.
+
+Three checkpoint windows stay active; messages above the high watermark are
+buffered *and* tallied into a per-node highest-checkpoint map, which is how
+a lagging node detects it needs state transfer.  One deliberate departure
+from the reference: votes are deduplicated per (node, value) — the
+reference double-counts a vote that arrives above the window and is then
+re-applied from the buffer after the window slides (checkpoints.go:124-134
+with :269-275), which lets a single node inflate agreement counts.
+"""
+
+from __future__ import annotations
+
+from .. import pb
+from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
+from .persisted import Persisted
+from .quorum import intersection_quorum, some_correct_quorum
+
+
+class CheckpointDivergenceError(Exception):
+    """Our computed checkpoint value disagrees with the network's committed
+    value — byzantine assumptions exceeded or the application is
+    non-deterministic."""
+
+
+class Checkpoint:
+    """Agreement state for one checkpoint seq_no (reference:
+    checkpoints.go:257-304)."""
+
+    def __init__(self, seq_no: int, network_config, my_id: int):
+        self.seq_no = seq_no
+        self.network_config = network_config
+        self.my_id = my_id
+        self.votes: dict[bytes, set] = {}  # value -> node IDs
+        self.committed_value: bytes | None = None
+        self.my_value: bytes | None = None
+        self.stable = False
+
+    def apply_checkpoint_msg(self, source: int, value: bytes) -> None:
+        nodes = self.votes.setdefault(value, set())
+        nodes.add(source)
+
+        if (
+            self.committed_value is None
+            and len(nodes) >= some_correct_quorum(self.network_config)
+        ):
+            self.committed_value = value
+
+        if source == self.my_id:
+            self.my_value = value
+
+        if (
+            self.my_value is not None
+            and self.committed_value is not None
+            and not self.stable
+        ):
+            if self.my_value != self.committed_value:
+                raise CheckpointDivergenceError(
+                    f"seq_no {self.seq_no}: our value {self.my_value!r} != "
+                    f"network committed {self.committed_value!r}"
+                )
+            if len(self.votes[self.committed_value]) >= intersection_quorum(
+                self.network_config
+            ):
+                self.stable = True
+
+
+class CheckpointTracker:
+    def __init__(
+        self,
+        persisted: Persisted,
+        node_buffers: NodeBuffers,
+        my_config: pb.InitialParameters,
+        logger=None,
+    ):
+        self.persisted = persisted
+        self.node_buffers = node_buffers
+        self.my_config = my_config
+        self.logger = logger
+
+        self.garbage_collectable = False
+        self.network_config = None
+        self.checkpoint_map: dict[int, Checkpoint] = {}
+        self.active: list[Checkpoint] = []  # ascending seq_no, >= 3 entries
+        self.highest_checkpoints: dict[int, int] = {}  # node -> seq_no
+        self.msg_buffers: dict[int, MsgBuffer] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reinitialize(self) -> None:
+        old_map = self.checkpoint_map
+        old_buffers = self.msg_buffers
+
+        self.garbage_collectable = False
+        self.network_config = None
+        self.checkpoint_map = {}
+        self.active = []
+        self.highest_checkpoints = {}
+        self.msg_buffers = {}
+
+        def on_c_entry(c_entry):
+            if self.network_config is None:
+                self.network_config = c_entry.network_state.config
+            cp = self.checkpoint(c_entry.seq_no)
+            cp.apply_checkpoint_msg(self.my_config.id, c_entry.checkpoint_value)
+            self.active.append(cp)
+
+        self.persisted.iterate({pb.CEntry: on_c_entry})
+
+        if not self.active:
+            raise AssertionError("no checkpoints in the log")
+        self.active[0].stable = True
+
+        valid_nodes = set(self.network_config.nodes)
+        for node_id in self.network_config.nodes:
+            buffer = old_buffers.get(node_id)
+            if buffer is None:
+                buffer = MsgBuffer(
+                    "checkpoints", self.node_buffers.node_buffer(node_id)
+                )
+            self.msg_buffers[node_id] = buffer
+
+        # Replay surviving votes from before the reinitialization.
+        for seq_no in sorted(old_map):
+            if seq_no < self.low_watermark():
+                continue
+            for value in sorted(old_map[seq_no].votes):
+                for node in sorted(old_map[seq_no].votes[value]):
+                    if node in valid_nodes:
+                        self.apply_checkpoint_msg(node, seq_no, value)
+
+        self.garbage_collect()
+
+    # -- watermarks ----------------------------------------------------------
+
+    def low_watermark(self) -> int:
+        return self.active[0].seq_no
+
+    def high_watermark(self) -> int:
+        return self.active[-1].seq_no
+
+    def checkpoint(self, seq_no: int) -> Checkpoint:
+        cp = self.checkpoint_map.get(seq_no)
+        if cp is None:
+            cp = Checkpoint(seq_no, self.network_config, self.my_config.id)
+            self.checkpoint_map[seq_no] = cp
+        return cp
+
+    # -- message handling ----------------------------------------------------
+
+    def filter(self, _source: int, msg: pb.Msg) -> Applyable:
+        cp_msg = msg.type
+        if cp_msg.seq_no < self.low_watermark():
+            return Applyable.PAST
+        if cp_msg.seq_no > self.high_watermark():
+            return Applyable.FUTURE
+        return Applyable.CURRENT
+
+    def step(self, source: int, msg: pb.Msg) -> None:
+        verdict = self.filter(source, msg)
+        if verdict is Applyable.PAST:
+            return
+        if verdict is Applyable.FUTURE:
+            # Buffer for re-application after the window slides, but also
+            # tally now so highest-checkpoint tracking (state-transfer
+            # detection) sees it.  Vote dedup makes the re-application safe.
+            self.msg_buffers[source].store(msg)
+        self.apply_msg(source, msg)
+
+    def apply_msg(self, source: int, msg: pb.Msg) -> None:
+        cp_msg = msg.type
+        if not isinstance(cp_msg, pb.Checkpoint):
+            raise AssertionError(f"unexpected msg type {type(cp_msg).__name__}")
+        self.apply_checkpoint_msg(source, cp_msg.seq_no, cp_msg.value)
+
+    def apply_checkpoint_msg(self, source: int, seq_no: int, value: bytes) -> None:
+        above_high = seq_no > self.high_watermark()
+        if above_high:
+            highest = self.highest_checkpoints.get(source)
+            if highest is not None and highest >= seq_no:
+                # We already hold an equal-or-newer above-window claim from
+                # this node; the buffered copy of this message will still be
+                # applied when the window slides.
+                return
+            self.highest_checkpoints[source] = seq_no
+
+        cp = self.checkpoint(seq_no)
+        cp.apply_checkpoint_msg(source, value)
+
+        if cp.stable and seq_no > self.low_watermark() and not above_high:
+            self.garbage_collectable = True
+            return
+
+        if not above_high:
+            return
+
+        # GC above-window checkpoint objects no node references anymore.
+        referenced = {c.seq_no for c in self.active}
+        referenced.update(self.highest_checkpoints.values())
+        for sn in list(self.checkpoint_map):
+            if sn not in referenced:
+                del self.checkpoint_map[sn]
+
+    # -- garbage collection --------------------------------------------------
+
+    def garbage_collect(self) -> int:
+        """Slide the window past the highest stable checkpoint; returns the
+        new low watermark.  Caller (StateMachine) truncates the WAL and GCs
+        the other trackers with it."""
+        highest_stable_idx = 0
+        for i, cp in enumerate(self.active):
+            if not cp.stable:
+                break
+            highest_stable_idx = i
+
+        for cp in self.active[:highest_stable_idx]:
+            self.checkpoint_map.pop(cp.seq_no, None)
+        self.active = self.active[highest_stable_idx:]
+
+        ci = self.network_config.checkpoint_interval
+        while len(self.active) < 3:
+            self.active.append(self.checkpoint(self.high_watermark() + ci))
+
+        for node_id in self.network_config.nodes:
+            self.msg_buffers[node_id].iterate(self.filter, self.apply_msg)
+
+        self.garbage_collectable = False
+        return self.active[0].seq_no

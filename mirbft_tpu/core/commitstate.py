@@ -1,0 +1,259 @@
+"""Ordering committed batches for the application and driving checkpoints.
+
+Rebuild of the reference's commit state (reference: commitstate.go:17-279).
+Two checkpoint windows of commits are held in half-interval ring buffers;
+when every sequence up to a checkpoint boundary has been applied, a
+checkpoint request is emitted to the application, and commits for the next
+window proceed while it computes (checkpoint-interval pipelining).
+``stop_at_seq_no`` throttles how far ordering may run ahead: two intervals
+normally, one when a reconfiguration is pending (the network must quiesce
+into the reconfigured state).
+
+State transfer: when the node must catch up, a TEntry is persisted and a
+state-transfer action emitted; a crash mid-transfer is detected on
+reinitialize by a TEntry newer than the last CEntry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .. import pb
+from .actions import Actions, CheckpointReq, CommitAction, StateTarget
+from .persisted import Persisted
+
+
+def next_network_config(starting_state: pb.NetworkState, client_configs: list):
+    """Apply pending reconfigurations to produce the next config + client
+    set (reference: commitstate.go:192-226)."""
+    if not starting_state.pending_reconfigurations:
+        return starting_state.config, client_configs
+
+    next_config = replace(starting_state.config)
+    next_clients = list(client_configs)
+    for reconfig in starting_state.pending_reconfigurations:
+        change = reconfig.type
+        if isinstance(change, pb.ReconfigNewClient):
+            next_clients.append(
+                pb.NetworkClient(id=change.id, width=change.width)
+            )
+        elif isinstance(change, pb.ReconfigRemoveClient):
+            remaining = [c for c in next_clients if c.id != change.client_id]
+            if len(remaining) == len(next_clients):
+                raise AssertionError(
+                    f"asked to remove client {change.client_id} which "
+                    f"doesn't exist"
+                )
+            next_clients = remaining
+        elif isinstance(change, pb.NetworkConfig):
+            next_config = change
+        else:
+            raise AssertionError(f"unknown reconfiguration {change!r}")
+    return next_config, next_clients
+
+
+class CommitState:
+    def __init__(self, persisted: Persisted, client_tracker, logger=None):
+        self.persisted = persisted
+        self.client_tracker = client_tracker
+        self.logger = logger
+
+        self.low_watermark = 0
+        self.last_applied_commit = 0
+        self.highest_commit = 0
+        self.stop_at_seq_no = 0
+        self.active_state: pb.NetworkState | None = None
+        self.lower_half: list = []
+        self.upper_half: list = []
+        self.checkpoint_pending = False
+        self.transferring = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reinitialize(self) -> Actions:
+        last_c = second_to_last_c = None
+        last_t = None
+
+        def on_c(c_entry):
+            nonlocal last_c, second_to_last_c
+            second_to_last_c = last_c
+            last_c = c_entry
+
+        def on_t(t_entry):
+            nonlocal last_t
+            last_t = t_entry
+
+        self.persisted.iterate({pb.CEntry: on_c, pb.TEntry: on_t})
+
+        if (
+            second_to_last_c is None
+            or not second_to_last_c.network_state.pending_reconfigurations
+        ):
+            self.active_state = last_c.network_state
+            self.low_watermark = last_c.seq_no
+        else:
+            # The previous checkpoint carried reconfigurations: the active
+            # state is still the pre-reconfig one until the network quiesces.
+            self.active_state = second_to_last_c.network_state
+            self.low_watermark = second_to_last_c.seq_no
+
+        ci = self.active_state.config.checkpoint_interval
+        if not self.active_state.pending_reconfigurations:
+            self.stop_at_seq_no = last_c.seq_no + 2 * ci
+        else:
+            self.stop_at_seq_no = last_c.seq_no + ci
+
+        self.last_applied_commit = last_c.seq_no
+        self.highest_commit = last_c.seq_no
+        self.lower_half = [None] * ci
+        self.upper_half = [None] * ci
+        self.checkpoint_pending = False
+
+        if last_t is None or last_c.seq_no >= last_t.seq_no:
+            self.transferring = False
+            return Actions()
+
+        # Crashed mid state-transfer: resume it.
+        self.transferring = True
+        actions = Actions()
+        actions.state_transfer = StateTarget(
+            seq_no=last_t.seq_no, value=last_t.value
+        )
+        return actions
+
+    def transfer_to(self, seq_no: int, value: bytes) -> Actions:
+        if self.transferring:
+            raise AssertionError("concurrent state transfers not supported")
+        self.transferring = True
+        actions = self.persisted.add_t_entry(
+            pb.TEntry(seq_no=seq_no, value=value)
+        )
+        actions.state_transfer = StateTarget(seq_no=seq_no, value=value)
+        return actions
+
+    # -- checkpoint results --------------------------------------------------
+
+    def apply_checkpoint_result(
+        self, epoch_config, result: pb.CheckpointResult
+    ) -> Actions:
+        ci = self.active_state.config.checkpoint_interval
+
+        if self.transferring:
+            return Actions()
+
+        if result.seq_no != self.low_watermark + ci:
+            raise AssertionError(
+                f"checkpoint result for {result.seq_no}, expected "
+                f"{self.low_watermark + ci}"
+            )
+
+        if not result.network_state.pending_reconfigurations:
+            self.stop_at_seq_no = result.seq_no + 2 * ci
+        # else: pending reconfigurations — do not extend the stop.
+
+        self.active_state = result.network_state
+        self.lower_half = self.upper_half
+        self.upper_half = [None] * ci
+        self.low_watermark = result.seq_no
+        self.checkpoint_pending = False
+
+        actions = self.persisted.add_c_entry(
+            pb.CEntry(
+                seq_no=result.seq_no,
+                checkpoint_value=result.value,
+                network_state=result.network_state,
+            )
+        )
+        actions.send(
+            self.active_state.config.nodes,
+            pb.Msg(type=pb.Checkpoint(seq_no=result.seq_no, value=result.value)),
+        )
+        return actions.concat(self.client_tracker.drain())
+
+    # -- commits -------------------------------------------------------------
+
+    def commit(self, q_entry: pb.QEntry) -> None:
+        if self.transferring:
+            raise AssertionError("must never commit during state transfer")
+        if q_entry.seq_no > self.stop_at_seq_no:
+            raise AssertionError(
+                f"commit {q_entry.seq_no} exceeds stop {self.stop_at_seq_no}"
+            )
+        if q_entry.seq_no <= self.low_watermark:
+            # Replayed commits during epoch change: already applied.
+            return
+
+        if self.highest_commit < q_entry.seq_no:
+            if self.highest_commit + 1 != q_entry.seq_no:
+                raise AssertionError(
+                    f"commit {q_entry.seq_no} skips ahead of highest "
+                    f"{self.highest_commit}"
+                )
+            self.highest_commit = q_entry.seq_no
+
+        ci = self.active_state.config.checkpoint_interval
+        upper = q_entry.seq_no - self.low_watermark > ci
+        offset = (q_entry.seq_no - (self.low_watermark + 1)) % ci
+        commits = self.upper_half if upper else self.lower_half
+
+        existing = commits[offset]
+        if existing is not None:
+            if existing.digest != q_entry.digest:
+                raise AssertionError(
+                    f"seq_no {q_entry.seq_no} previously committed "
+                    f"{existing.digest!r} but now {q_entry.digest!r}"
+                )
+        else:
+            commits[offset] = q_entry
+
+    def drain(self) -> list:
+        """All in-order commits ready for the application, interleaved with
+        checkpoint requests at window boundaries (reference:
+        commitstate.go:229-279)."""
+        ci = self.active_state.config.checkpoint_interval
+        result: list[CommitAction] = []
+
+        while self.last_applied_commit < self.low_watermark + 2 * ci:
+            if (
+                self.last_applied_commit == self.low_watermark + ci
+                and not self.checkpoint_pending
+            ):
+                client_state = (
+                    self.client_tracker.commits_completed_for_checkpoint_window(
+                        self.last_applied_commit
+                    )
+                )
+                network_config, client_configs = next_network_config(
+                    self.active_state, client_state
+                )
+                result.append(
+                    CommitAction(
+                        checkpoint=CheckpointReq(
+                            seq_no=self.last_applied_commit,
+                            network_config=network_config,
+                            clients_state=client_configs,
+                        )
+                    )
+                )
+                self.checkpoint_pending = True
+
+            next_commit = self.last_applied_commit + 1
+            upper = next_commit - self.low_watermark > ci
+            offset = (next_commit - (self.low_watermark + 1)) % ci
+            commits = self.upper_half if upper else self.lower_half
+            q_entry = commits[offset]
+            if q_entry is None:
+                break
+            if q_entry.seq_no != next_commit:
+                raise AssertionError(
+                    f"out of order commit: {q_entry.seq_no} != {next_commit}"
+                )
+
+            result.append(CommitAction(batch=q_entry))
+            for ack in q_entry.requests:
+                self.client_tracker.mark_committed(
+                    ack.client_id, ack.req_no, q_entry.seq_no
+                )
+            self.last_applied_commit = next_commit
+
+        return result

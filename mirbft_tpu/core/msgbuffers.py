@@ -1,0 +1,107 @@
+"""Byte-budgeted buffering of not-yet-applyable messages, shared per remote
+node across all protocol components.
+
+Rebuild of the reference's msg buffers (reference: msgbuffers.go:17-161).
+Each component classifies a message as PAST (drop), CURRENT (apply), FUTURE
+(buffer until watermarks move), or INVALID (drop); one byte budget per
+remote node (InitialParameters.buffer_size) is shared by all components'
+buffers so a spammy peer can't hold unbounded memory.  On overflow the
+oldest buffered message is dropped first.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .. import pb
+
+
+class Applyable(enum.Enum):
+    PAST = 0
+    CURRENT = 1
+    FUTURE = 2
+    INVALID = 3
+
+
+class NodeBuffers:
+    """Tracks one shared byte budget per remote node."""
+
+    def __init__(self, my_config: pb.InitialParameters, logger=None):
+        self.my_config = my_config
+        self.logger = logger
+        self._nodes: dict[int, NodeBuffer] = {}
+
+    def node_buffer(self, source: int) -> "NodeBuffer":
+        nb = self._nodes.get(source)
+        if nb is None:
+            nb = NodeBuffer(source, self.my_config, self.logger)
+            self._nodes[source] = nb
+        return nb
+
+
+class NodeBuffer:
+    def __init__(self, node_id: int, my_config: pb.InitialParameters, logger=None):
+        self.node_id = node_id
+        self.my_config = my_config
+        self.logger = logger
+        self.total_size = 0
+
+    def over_capacity(self) -> bool:
+        return self.total_size > self.my_config.buffer_size
+
+
+class MsgBuffer:
+    """One component's FIFO of buffered messages from one node."""
+
+    def __init__(self, component: str, node_buffer: NodeBuffer):
+        self.component = component
+        self.node_buffer = node_buffer
+        self._buffer: list[tuple[pb.Msg, int]] = []
+
+    def __len__(self) -> int:
+        return len(self._buffer)
+
+    def store(self, msg: pb.Msg) -> None:
+        size = len(pb.encode(msg))
+        while self.node_buffer.over_capacity() and self._buffer:
+            _, old_size = self._buffer.pop(0)
+            self.node_buffer.total_size -= old_size
+            if self.node_buffer.logger is not None:
+                self.node_buffer.logger.warn(
+                    "dropping buffered msg",
+                    component=self.component,
+                    node=self.node_buffer.node_id,
+                )
+        self._buffer.append((msg, size))
+        self.node_buffer.total_size += size
+
+    def next(self, filter_fn):
+        """Remove and return the first CURRENT message; drop PAST/INVALID
+        encountered on the way; leave FUTURE in place."""
+        i = 0
+        while i < len(self._buffer):
+            msg, size = self._buffer[i]
+            verdict = filter_fn(self.node_buffer.node_id, msg)
+            if verdict is Applyable.FUTURE:
+                i += 1
+                continue
+            del self._buffer[i]
+            self.node_buffer.total_size -= size
+            if verdict is Applyable.CURRENT:
+                return msg
+            # PAST / INVALID: dropped, keep scanning.
+        return None
+
+    def iterate(self, filter_fn, apply_fn) -> None:
+        """Apply every CURRENT message, drop PAST/INVALID, keep FUTURE."""
+        i = 0
+        while i < len(self._buffer):
+            msg, size = self._buffer[i]
+            verdict = filter_fn(self.node_buffer.node_id, msg)
+            if verdict is Applyable.FUTURE:
+                i += 1
+                continue
+            del self._buffer[i]
+            self.node_buffer.total_size -= size
+            if verdict is Applyable.CURRENT:
+                apply_fn(self.node_buffer.node_id, msg)

@@ -1,0 +1,11 @@
+"""TPU compute plane: batched crypto kernels behind the Actions→Results seam.
+
+The reference's hot path is serial host hashing (reference:
+processor.go:133-143, `h := Hasher(); h.Write(...)`).  Here that compute is
+coalesced across action batches into fixed-shape arrays and dispatched to
+jit/vmap JAX kernels that XLA vectorizes over the TPU's VPU lanes, with
+bucketed padding to avoid recompilation storms.
+"""
+
+from .sha256 import sha256, sha256_many  # noqa: F401
+from .batching import PreimageBatch, pack_preimages  # noqa: F401

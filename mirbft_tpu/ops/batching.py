@@ -1,0 +1,69 @@
+"""Host-side packing of ragged preimages into fixed-shape kernel inputs.
+
+The hard part of putting consensus crypto on an accelerator is that hash
+preimages are variable-length while XLA wants static shapes (SURVEY hard
+part #3).  Strategy: pad every message with standard SHA-256 padding, round
+the block axis and the batch axis up to power-of-two buckets, and zero-fill
+the remainder.  Only O(log(max_len) * log(max_batch)) distinct shapes ever
+reach the compiler, so there are no recompilation storms; padded rows cost
+compute but not correctness (their block count is 0, so their lanes just
+carry the IV through the scan).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    v = max(n, floor)
+    return 1 << (v - 1).bit_length()
+
+
+def sha256_pad(message: bytes) -> bytes:
+    """FIPS 180-4 padding: 0x80, zeros, 64-bit big-endian bit length."""
+    bit_len = len(message) * 8
+    padded = message + b"\x80"
+    padded += b"\x00" * ((56 - len(padded)) % 64)
+    return padded + struct.pack(">Q", bit_len)
+
+
+@dataclass
+class PreimageBatch:
+    blocks: np.ndarray  # (batch, max_blocks, 16) uint32 big-endian words
+    n_blocks: np.ndarray  # (batch,) int32
+    position: list  # original message index -> row in blocks
+
+
+def pack_preimages(
+    messages: list,
+    block_floor: int = 1,
+    batch_floor: int = 8,
+) -> PreimageBatch:
+    """Pack byte strings into a bucketed, padded uint32 block tensor."""
+    padded = [sha256_pad(m) for m in messages]
+    counts = [len(p) // 64 for p in padded]
+
+    max_blocks = next_pow2(max(counts), block_floor)
+    batch = next_pow2(len(messages), batch_floor)
+
+    buf = np.zeros((batch, max_blocks * 64), dtype=np.uint8)
+    for i, p in enumerate(padded):
+        buf[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+
+    blocks = (
+        buf.view(">u4")
+        .astype(np.uint32)
+        .reshape(batch, max_blocks, 16)
+    )
+    n_blocks = np.zeros(batch, dtype=np.int32)
+    n_blocks[: len(counts)] = counts
+
+    return PreimageBatch(
+        blocks=blocks,
+        n_blocks=n_blocks,
+        position=list(range(len(messages))),
+    )

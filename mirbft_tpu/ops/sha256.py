@@ -1,0 +1,154 @@
+"""Batched SHA-256 as a JAX kernel.
+
+Bit-exact with hashlib.sha256 (FIPS 180-4) over the preimage layouts in
+core.preimage — that equality is the correctness gate (tests/test_sha256.py)
+and what makes a TPU run and a CPU-hash run produce identical event logs.
+
+Design for TPU:
+- Messages are padded on the host (standard SHA-256 padding) and packed into
+  a (batch, max_blocks, 16) uint32 tensor of big-endian words plus a (batch,)
+  block-count vector (ops.batching).  All shapes static per bucket.
+- The compression function is written over the whole batch at once: every
+  round's adds/rotates/xors are (batch,)-shaped vector ops, so XLA maps them
+  onto the VPU's 8x128 lanes across the batch dimension.  The 64 rounds are
+  unrolled (static Python loop) — a single fused kernel per block index.
+- Variable block counts are handled with a masked lax.scan over the block
+  axis: all messages advance through max_blocks compressions, but a
+  message's state freezes once its own block count is exhausted.  This keeps
+  control flow static (no data-dependent branching under jit).
+- Bucketed padding: callers round max_blocks and batch up to buckets
+  (ops.batching) so only a handful of shapes ever compile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fmt: off
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+_IV = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+# fmt: on
+
+
+def _rotr(x, n):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+# Partial unroll factor for the round/schedule scans: keeps the emitted HLO
+# small (fast compiles on every backend — fully unrolling the 64 rounds
+# takes *minutes* under CPU XLA) while giving the backend straight-line
+# stretches to software-pipeline.
+_UNROLL = 8
+
+
+def _compress_batch(state, block):
+    """One SHA-256 compression over a whole batch.
+
+    state: (batch, 8) uint32; block: (batch, 16) uint32 → (batch, 8).
+
+    Both the message-schedule expansion and the 64 rounds are lax.scans
+    whose bodies are fully (batch,)-vectorized — the batch dimension rides
+    the VPU lanes; the sequential dependency lives in the scan."""
+    # Message schedule: carry a rolling 16-word window, emit w_t.
+    window0 = jnp.moveaxis(block, 1, 0)  # (16, batch)
+
+    def sched_body(window, _):
+        w15, w2 = window[1], window[14]
+        w16, w7 = window[0], window[9]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        wt = w16 + s0 + w7 + s1
+        return jnp.concatenate([window[1:], wt[None]], axis=0), wt
+
+    _, w_rest = jax.lax.scan(
+        sched_body, window0, None, length=48, unroll=_UNROLL
+    )
+    w_all = jnp.concatenate([window0, w_rest], axis=0)  # (64, batch)
+
+    def round_body(vars8, inputs):
+        wt, kt = inputs
+        a, b, c, d, e, f, g, h = vars8
+        big_s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + big_s1 + ch + kt + wt
+        big_s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + big_s0 + maj, a, b, c, d + t1, e, f, g), None
+
+    vars8, _ = jax.lax.scan(
+        round_body,
+        tuple(state[:, i] for i in range(8)),
+        (w_all, jnp.asarray(_K)),
+        unroll=_UNROLL,
+    )
+    return state + jnp.stack(vars8, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("max_blocks",))
+def _sha256_blocks(blocks, n_blocks, *, max_blocks: int):
+    """blocks: (batch, max_blocks, 16) uint32 big-endian words;
+    n_blocks: (batch,) int32 — actual block count per message.
+    Returns (batch, 8) uint32 digest words."""
+    batch = blocks.shape[0]
+    init = jnp.broadcast_to(jnp.asarray(_IV), (batch, 8))
+
+    def body(state, inputs):
+        block, j = inputs
+        new_state = _compress_batch(state, block)
+        live = (j < n_blocks)[:, None]
+        return jnp.where(live, new_state, state), None
+
+    state, _ = jax.lax.scan(
+        body,
+        init,
+        (jnp.moveaxis(blocks, 1, 0), jnp.arange(max_blocks, dtype=jnp.int32)),
+    )
+    return state
+
+
+def sha256_digest_words(blocks, n_blocks):
+    """Run the kernel on pre-packed blocks (see ops.batching)."""
+    return _sha256_blocks(blocks, n_blocks, max_blocks=blocks.shape[1])
+
+
+def sha256(message: bytes) -> bytes:
+    """Single-message convenience wrapper (prefer sha256_many for batches)."""
+    return sha256_many([message])[0]
+
+
+def sha256_many(messages: list) -> list:
+    """Digest a list of byte strings on the accelerator, preserving order.
+
+    Messages are bucketed by padded block count so only a few shapes ever
+    compile; each bucket is one kernel launch."""
+    from .batching import pack_preimages  # local import to avoid cycle
+
+    if not messages:
+        return []
+    batch = pack_preimages(messages)
+    words = sha256_digest_words(batch.blocks, batch.n_blocks)
+    raw = np.asarray(words).astype(">u4").tobytes()
+    return [
+        raw[32 * batch.position[i] : 32 * batch.position[i] + 32]
+        for i in range(len(messages))
+    ]

@@ -1,0 +1,20 @@
+"""Multi-chip scaling: device meshes, sharded crypto, collective tallies.
+
+The reference scales with host-level concurrency (goroutine work pools,
+reference: processor.go:183-470).  The TPU-native equivalents:
+
+- the digest batch is data-parallel across a device mesh (each chip hashes a
+  shard of the preimages);
+- quorum tallies (prepare/commit/ack counting, reference: sequence.go:72-73,
+  client_tracker.go:1018-1026) become on-device reductions with psum across
+  the mesh's node axis riding ICI.
+
+See sharding.py; __graft_entry__.dryrun_multichip drives this path on a
+virtual device mesh.
+"""
+
+from .sharding import (  # noqa: F401
+    make_mesh,
+    sharded_sha256,
+    sharded_quorum_tally,
+)

@@ -17,3 +17,8 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Persistent XLA compilation cache: the SHA-256 kernel shapes are stable
+# across test runs, so paying the compile cost once keeps the suite fast.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/mirbft_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")

@@ -1,0 +1,51 @@
+"""Above-window highest-checkpoint tracking: the map must follow each
+node's *newest* claim (lagging-node / state-transfer detection) and stay
+bounded to one above-window entry per node."""
+
+from mirbft_tpu import pb
+from mirbft_tpu.core.checkpoints import CheckpointTracker
+from mirbft_tpu.core.msgbuffers import NodeBuffers
+from mirbft_tpu.core.persisted import Persisted
+
+
+def _tracker():
+    persisted = Persisted()
+    persisted.add_c_entry(
+        pb.CEntry(
+            seq_no=0,
+            checkpoint_value=b"genesis",
+            network_state=pb.NetworkState(
+                config=pb.NetworkConfig(
+                    nodes=[0, 1, 2, 3],
+                    f=1,
+                    number_of_buckets=4,
+                    checkpoint_interval=5,
+                    max_epoch_length=50,
+                )
+            ),
+        )
+    )
+    my = pb.InitialParameters(id=0, buffer_size=1 << 20)
+    t = CheckpointTracker(persisted, NodeBuffers(my), my)
+    t.reinitialize()
+    return t
+
+
+def test_highest_tracks_newest_claim():
+    t = _tracker()
+    t.step(3, pb.Msg(type=pb.Checkpoint(seq_no=40, value=b"c40")))
+    assert t.highest_checkpoints[3] == 40
+    t.step(3, pb.Msg(type=pb.Checkpoint(seq_no=60, value=b"c60")))
+    assert t.highest_checkpoints[3] == 60
+    # A replayed older above-window claim must not move the map down.
+    t.step(3, pb.Msg(type=pb.Checkpoint(seq_no=35, value=b"c35")))
+    assert t.highest_checkpoints[3] == 60
+
+
+def test_above_window_map_stays_bounded():
+    t = _tracker()
+    for seq in (40, 60, 80, 100):
+        t.step(3, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"x")))
+    # Only the active windows plus node 3's newest claim survive.
+    above_window = [s for s in t.checkpoint_map if s > t.high_watermark()]
+    assert above_window == [100]

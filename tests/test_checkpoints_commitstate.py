@@ -1,0 +1,292 @@
+"""Gates for core.checkpoints (value agreement, windows, buffering) and
+core.commitstate (ring buffers, checkpoint pipelining, stop throttle,
+state-transfer resume) — VERDICT r2 item 5."""
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core.actions import Actions
+from mirbft_tpu.core.checkpoints import (
+    Checkpoint,
+    CheckpointDivergenceError,
+    CheckpointTracker,
+)
+from mirbft_tpu.core.commitstate import CommitState, next_network_config
+from mirbft_tpu.core.msgbuffers import NodeBuffers
+from mirbft_tpu.core.persisted import Persisted
+
+
+def network_config(n=4, f=1, ci=5):
+    return pb.NetworkConfig(
+        nodes=list(range(n)),
+        f=f,
+        number_of_buckets=n,
+        checkpoint_interval=ci,
+        max_epoch_length=10 * ci,
+    )
+
+
+def network_state(n=4, f=1, ci=5, reconfigs=()):
+    return pb.NetworkState(
+        config=network_config(n, f, ci),
+        clients=[],
+        pending_reconfigurations=list(reconfigs),
+    )
+
+
+def centry(seq, value=b"cp", state=None):
+    return pb.CEntry(
+        seq_no=seq,
+        checkpoint_value=value,
+        network_state=state if state is not None else network_state(),
+    )
+
+
+MY = pb.InitialParameters(id=0, buffer_size=1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint value agreement
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_agreement_rules():
+    cp = Checkpoint(20, network_config(), my_id=0)
+    cp.apply_checkpoint_msg(1, b"v")
+    assert cp.committed_value is None
+    cp.apply_checkpoint_msg(2, b"v")  # f+1 = 2 -> committed
+    assert cp.committed_value == b"v"
+    assert not cp.stable
+    cp.apply_checkpoint_msg(0, b"v")  # own value + 3 >= 2f+1 -> stable
+    assert cp.stable
+
+
+def test_checkpoint_votes_deduped():
+    cp = Checkpoint(20, network_config(), my_id=0)
+    cp.apply_checkpoint_msg(1, b"v")
+    cp.apply_checkpoint_msg(1, b"v")
+    assert cp.committed_value is None  # still one vote, not f+1
+
+
+def test_checkpoint_divergence_raises():
+    cp = Checkpoint(20, network_config(), my_id=0)
+    cp.apply_checkpoint_msg(1, b"net")
+    cp.apply_checkpoint_msg(2, b"net")
+    with pytest.raises(CheckpointDivergenceError):
+        cp.apply_checkpoint_msg(0, b"mine")
+
+
+# ---------------------------------------------------------------------------
+# CheckpointTracker
+# ---------------------------------------------------------------------------
+
+
+def make_tracker(*c_entries):
+    persisted = Persisted()
+    for e in c_entries:
+        persisted.add_c_entry(e)
+    tracker = CheckpointTracker(persisted, NodeBuffers(MY), MY)
+    tracker.reinitialize()
+    return tracker
+
+
+def test_tracker_reinitialize_extends_to_three_windows():
+    t = make_tracker(centry(0, b"genesis"))
+    assert t.low_watermark() == 0
+    assert t.high_watermark() == 10  # 0, 5, 10 with ci=5
+    assert [cp.seq_no for cp in t.active] == [0, 5, 10]
+    assert t.active[0].stable
+
+
+def test_tracker_step_to_stable_and_gc():
+    t = make_tracker(centry(0, b"genesis"))
+    msg = pb.Msg(type=pb.Checkpoint(seq_no=5, value=b"cp5"))
+    for node in (1, 2):
+        t.step(node, msg)
+    assert not t.garbage_collectable
+    t.step(0, msg)  # own vote arrives via loopback send
+    assert t.garbage_collectable
+    new_low = t.garbage_collect()
+    assert new_low == 5
+    assert [cp.seq_no for cp in t.active] == [5, 10, 15]
+    assert not t.garbage_collectable
+
+
+def test_tracker_buffers_future_and_replays_after_slide():
+    t = make_tracker(centry(0, b"genesis"))
+    future = pb.Msg(type=pb.Checkpoint(seq_no=15, value=b"cp15"))
+    for node in (0, 1, 2):
+        t.step(node, future)  # above high watermark 10: buffered + tallied
+    assert t.checkpoint_map[15].votes[b"cp15"] == {0, 1, 2}
+    # Slide to 5: cp15 now in-window; replay is deduped, no double count.
+    msg5 = pb.Msg(type=pb.Checkpoint(seq_no=5, value=b"cp5"))
+    for node in (0, 1, 2):
+        t.step(node, msg5)
+    t.garbage_collect()
+    assert t.checkpoint_map[15].votes[b"cp15"] == {0, 1, 2}
+    # cp15 became stable during replay (own + 2f+1 votes, in window now).
+    assert t.checkpoint_map[15].stable
+
+
+def test_tracker_past_msgs_dropped():
+    t = make_tracker(centry(0), centry(5, b"cp5"))
+    # Window starts at the *first* CEntry; seq 0 votes are current, then
+    # after GC to 5, seq 0 is past.
+    msg5 = pb.Msg(type=pb.Checkpoint(seq_no=5, value=b"cp5"))
+    for node in (0, 1, 2):
+        t.step(node, msg5)
+    t.garbage_collect()
+    assert t.low_watermark() == 5
+    msg0 = pb.Msg(type=pb.Checkpoint(seq_no=0, value=b"x"))
+    t.step(3, msg0)  # silently dropped
+    assert 0 not in t.checkpoint_map
+
+
+# ---------------------------------------------------------------------------
+# CommitState
+# ---------------------------------------------------------------------------
+
+
+class StubClientTracker:
+    def __init__(self):
+        self.committed = []
+
+    def drain(self):
+        return Actions()
+
+    def commits_completed_for_checkpoint_window(self, seq_no):
+        return [pb.NetworkClient(id=1, width=10)]
+
+    def mark_committed(self, client_id, req_no, seq_no):
+        self.committed.append((client_id, req_no, seq_no))
+
+
+def make_commit_state(*entries, ci=5):
+    persisted = Persisted()
+    for e in entries:
+        if isinstance(e, pb.CEntry):
+            persisted.add_c_entry(e)
+        elif isinstance(e, pb.TEntry):
+            persisted.add_t_entry(e)
+    cs = CommitState(persisted, StubClientTracker())
+    boot_actions = cs.reinitialize()
+    return cs, boot_actions
+
+
+def qentry(seq, digest=b"d", reqs=()):
+    return pb.QEntry(seq_no=seq, digest=digest, requests=list(reqs))
+
+
+def test_commit_state_reinitialize():
+    cs, actions = make_commit_state(centry(0, b"genesis"))
+    assert actions.is_empty()
+    assert cs.low_watermark == 0
+    assert cs.stop_at_seq_no == 10  # 2 * ci
+    assert not cs.transferring
+
+
+def test_commit_drain_in_order_with_checkpoint_request():
+    cs, _ = make_commit_state(centry(0, b"genesis"))
+    # Commit seqs 1..5 out of order; drain only returns in-order prefix.
+    cs.commit(qentry(1))
+    cs.commit(qentry(2))
+    drained = cs.drain()
+    assert [c.batch.seq_no for c in drained] == [1, 2]
+    cs.commit(qentry(3))
+    cs.commit(qentry(4))
+    with pytest.raises(AssertionError):
+        cs.commit(qentry(6))  # gap: commits reach commit state in order
+    drained = cs.drain()
+    assert [c.batch.seq_no for c in drained] == [3, 4]
+    cs.commit(qentry(5))
+    drained = cs.drain()
+    # Seq 5 commits, then the checkpoint request for seq 5 fires on the
+    # *next* drain pass... actually within the same drain: batch 5 then
+    # checkpoint once last_applied == low+ci.
+    kinds = [
+        ("cp" if c.checkpoint is not None else c.batch.seq_no) for c in drained
+    ]
+    assert kinds == [5, "cp"]
+    cp_req = drained[-1].checkpoint
+    assert cp_req.seq_no == 5
+    assert cp_req.clients_state[0].id == 1
+    # Commits continue into the upper half while the checkpoint computes.
+    cs.commit(qentry(6))
+    assert [c.batch.seq_no for c in cs.drain()] == [6]
+
+
+def test_checkpoint_result_slides_window():
+    cs, _ = make_commit_state(centry(0, b"genesis"))
+    for s in range(1, 7):
+        cs.commit(qentry(s))
+    cs.drain()
+    result = pb.CheckpointResult(
+        seq_no=5, value=b"cp5", network_state=network_state()
+    )
+    actions = cs.apply_checkpoint_result(None, result)
+    # CEntry persisted + Checkpoint broadcast.
+    assert any(
+        isinstance(w.append.data.type, pb.CEntry) for w in actions.write_ahead
+    )
+    [send] = actions.sends
+    assert send.msg == pb.Msg(type=pb.Checkpoint(seq_no=5, value=b"cp5"))
+    assert cs.low_watermark == 5
+    assert cs.stop_at_seq_no == 15
+    # Seq 6 (committed into upper half) survives the slide into lower half.
+    drained = cs.drain()
+    assert drained == []  # 6 already applied before the slide
+    cs.commit(qentry(7))
+    assert [c.batch.seq_no for c in cs.drain()] == [7]
+
+
+def test_stop_at_seq_no_enforced():
+    cs, _ = make_commit_state(centry(0, b"genesis"))
+    with pytest.raises(AssertionError):
+        cs.commit(qentry(11))  # beyond stop at 10
+
+
+def test_pending_reconfiguration_shortens_stop():
+    state = network_state(
+        reconfigs=[pb.Reconfiguration(type=pb.ReconfigNewClient(id=9, width=5))]
+    )
+    cs, _ = make_commit_state(centry(0, b"genesis", state=state))
+    assert cs.stop_at_seq_no == 5  # 1 * ci, not 2
+
+
+def test_next_network_config_applies_reconfigs():
+    state = network_state(
+        reconfigs=[
+            pb.Reconfiguration(type=pb.ReconfigNewClient(id=9, width=5)),
+            pb.Reconfiguration(type=pb.ReconfigRemoveClient(client_id=1)),
+        ]
+    )
+    clients = [pb.NetworkClient(id=1, width=10), pb.NetworkClient(id=2, width=10)]
+    config, next_clients = next_network_config(state, clients)
+    assert [c.id for c in next_clients] == [2, 9]
+    assert config == state.config
+
+
+def test_crash_mid_transfer_resumes():
+    cs, actions = make_commit_state(
+        centry(0, b"genesis"), pb.TEntry(seq_no=20, value=b"target")
+    )
+    assert cs.transferring
+    assert actions.state_transfer.seq_no == 20
+    assert actions.state_transfer.value == b"target"
+
+
+def test_commit_marks_client_requests():
+    cs, _ = make_commit_state(centry(0, b"genesis"))
+    cs.commit(
+        qentry(1, reqs=[pb.RequestAck(client_id=7, req_no=3, digest=b"d")])
+    )
+    cs.drain()
+    assert cs.client_tracker.committed == [(7, 3, 1)]
+
+
+def test_duplicate_commit_same_digest_ok_different_raises():
+    cs, _ = make_commit_state(centry(0, b"genesis"))
+    cs.commit(qentry(1, digest=b"d"))
+    cs.commit(qentry(1, digest=b"d"))  # idempotent
+    with pytest.raises(AssertionError):
+        cs.commit(qentry(1, digest=b"other"))

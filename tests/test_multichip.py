@@ -1,0 +1,62 @@
+"""Gate for the multi-chip crypto plane: the sharded digest + quorum-tally
+step compiles and runs on the 8-device virtual CPU mesh (conftest), and the
+graft entry points work (VERDICT r1 item 3)."""
+
+import hashlib
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from mirbft_tpu.ops.batching import pack_preimages
+from mirbft_tpu.parallel.sharding import (
+    make_mesh,
+    sharded_quorum_tally,
+    sharded_sha256,
+)
+
+
+needs_8 = pytest.mark.skipif(
+    len(jax.devices("cpu")) < 8, reason="needs 8 virtual cpu devices"
+)
+
+
+@needs_8
+def test_sharded_sha256_matches_hashlib():
+    mesh = make_mesh(8)
+    messages = [bytes([i]) * (i + 1) for i in range(16)]
+    packed = pack_preimages(messages, batch_floor=8)
+    digest = sharded_sha256(mesh)
+    words = np.asarray(digest(packed.blocks, packed.n_blocks))
+    for i, msg in enumerate(messages):
+        assert words[i].astype(">u4").tobytes() == hashlib.sha256(msg).digest()
+
+
+@needs_8
+def test_sharded_quorum_tally():
+    mesh = make_mesh(8)
+    tally = sharded_quorum_tally(mesh)
+    votes = np.zeros((8, 4), dtype=np.int8)
+    votes[:6, 0] = 1  # 6 votes -> quorum at threshold 6
+    votes[:5, 1] = 1  # 5 votes -> no quorum
+    votes[:, 2] = 1  # unanimous
+    mask = np.asarray(tally(votes, threshold=6))
+    assert list(mask) == [True, False, True, False]
+
+
+@needs_8
+def test_dryrun_multichip_entry_point():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(8)
+
+
+def test_entry_point_compiles():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as graft
+
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (args[0].shape[0], 8)

@@ -1,0 +1,76 @@
+"""Bit-exactness gate for the TPU SHA-256 kernel vs hashlib (SURVEY §7
+stage 4 gate), including fuzzed lengths across block boundaries and the
+protocol preimage layouts."""
+
+import hashlib
+import random
+
+from mirbft_tpu import pb
+from mirbft_tpu.core import preimage
+from mirbft_tpu.ops import sha256, sha256_many
+from mirbft_tpu.ops.batching import next_pow2, pack_preimages, sha256_pad
+
+
+def test_next_pow2():
+    assert next_pow2(1) == 1
+    assert next_pow2(3) == 4
+    assert next_pow2(4) == 4
+    assert next_pow2(5, floor=8) == 8
+    assert next_pow2(1000) == 1024
+
+
+def test_sha256_pad_lengths():
+    for n in [0, 1, 54, 55, 56, 63, 64, 65, 119, 120, 128]:
+        padded = sha256_pad(b"x" * n)
+        assert len(padded) % 64 == 0
+        assert padded[n] == 0x80
+
+
+def test_empty_message():
+    assert sha256(b"") == hashlib.sha256(b"").digest()
+
+
+def test_known_vectors():
+    for msg in [b"abc", b"hello world", b"a" * 1000]:
+        assert sha256(msg) == hashlib.sha256(msg).digest()
+
+
+def test_block_boundary_fuzz():
+    rng = random.Random(42)
+    # Every length near block boundaries plus random lengths (capped so the
+    # block-axis bucket stays small: compile time, not correctness).
+    lengths = list(range(0, 130)) + [rng.randrange(0, 1024) for _ in range(40)]
+    messages = [bytes(rng.getrandbits(8) for _ in range(n)) for n in lengths]
+    digests = sha256_many(messages)
+    for msg, digest in zip(messages, digests):
+        assert digest == hashlib.sha256(msg).digest(), f"len={len(msg)}"
+
+
+def test_protocol_preimages_match_host_oracle():
+    rng = random.Random(7)
+    messages = []
+    for _ in range(32):
+        req = pb.Request(
+            client_id=rng.randrange(2**32),
+            req_no=rng.randrange(2**32),
+            data=bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 500))),
+        )
+        messages.append(b"".join(preimage.request_hash_data(req)))
+        acks = [
+            pb.RequestAck(digest=bytes(rng.getrandbits(8) for _ in range(32)))
+            for _ in range(rng.randrange(1, 30))
+        ]
+        messages.append(b"".join(preimage.batch_hash_data(acks)))
+    digests = sha256_many(messages)
+    for msg, digest in zip(messages, digests):
+        assert digest == preimage.host_digest([msg])
+
+
+def test_packing_shapes_are_bucketed():
+    batch = pack_preimages([b"x"] * 5)
+    assert batch.blocks.shape == (8, 1, 16)  # batch 5→8, 1 block
+    batch = pack_preimages([b"x" * 200, b"y"])
+    # 200 bytes → 208 padded → 4 blocks; bucket stays 4.
+    assert batch.blocks.shape == (8, 4, 16)
+    assert list(batch.n_blocks[:2]) == [4, 1]
+    assert list(batch.n_blocks[2:]) == [0] * 6
