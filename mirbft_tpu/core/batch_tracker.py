@@ -1,0 +1,135 @@
+"""Tracking and fetching of preprepared batches for epoch change.
+
+Rebuild of the reference's batch tracker (reference: batch_tracker.go).
+Every QEntry's batch is remembered (by digest, with the sequences that
+referenced it) so that during epoch change a node can serve FetchBatch
+requests from peers that selected a digest they don't hold; forwarded
+batches are verified by rehashing before acceptance.
+"""
+
+from __future__ import annotations
+
+from .. import pb
+from .actions import Actions
+from .persisted import Persisted
+
+
+class ByzantineBatchForward(Exception):
+    """A forwarded batch did not hash to its claimed digest."""
+
+
+class _Batch:
+    __slots__ = ("observed_sequences", "request_acks")
+
+    def __init__(self, request_acks):
+        self.observed_sequences = set()
+        self.request_acks = request_acks
+
+
+class BatchTracker:
+    def __init__(self, persisted: Persisted):
+        self.persisted = persisted
+        self.batches_by_digest: dict[bytes, _Batch] = {}
+        self.fetch_in_flight: dict[bytes, list] = {}  # digest -> [seq_no]
+
+    def reinitialize(self) -> None:
+        self.persisted.iterate(
+            {
+                pb.QEntry: lambda q: self.add_batch(
+                    q.seq_no, q.digest, q.requests
+                )
+            }
+        )
+
+    def step(self, source: int, msg: pb.Msg) -> Actions:
+        inner = msg.type
+        if isinstance(inner, pb.FetchBatch):
+            return self.reply_fetch_batch(source, inner.seq_no, inner.digest)
+        if isinstance(inner, pb.ForwardBatch):
+            return self.apply_forward_batch(
+                source, inner.seq_no, inner.digest, inner.request_acks
+            )
+        raise AssertionError(f"unexpected batch msg {type(inner).__name__}")
+
+    def truncate(self, seq_no: int) -> None:
+        for digest in list(self.batches_by_digest):
+            batch = self.batches_by_digest[digest]
+            batch.observed_sequences = {
+                s for s in batch.observed_sequences if s >= seq_no
+            }
+            if not batch.observed_sequences:
+                del self.batches_by_digest[digest]
+
+    def add_batch(self, seq_no: int, digest: bytes, request_acks: list) -> None:
+        batch = self.batches_by_digest.get(digest)
+        if batch is None:
+            batch = _Batch(request_acks)
+            self.batches_by_digest[digest] = batch
+        for in_flight_seq in self.fetch_in_flight.pop(digest, ()):
+            batch.observed_sequences.add(in_flight_seq)
+        batch.observed_sequences.add(seq_no)
+
+    def fetch_batch(self, seq_no: int, digest: bytes, sources: list) -> Actions:
+        in_flight = self.fetch_in_flight.setdefault(digest, [])
+        if seq_no in in_flight:
+            return Actions()
+        in_flight.append(seq_no)
+        return Actions().send(
+            sources, pb.Msg(type=pb.FetchBatch(seq_no=seq_no, digest=digest))
+        )
+
+    def reply_fetch_batch(self, source: int, seq_no: int, digest: bytes) -> Actions:
+        batch = self.batches_by_digest.get(digest)
+        if batch is None:
+            return Actions()  # not necessarily byzantine; just don't have it
+        return Actions().send(
+            [source],
+            pb.Msg(
+                type=pb.ForwardBatch(
+                    seq_no=seq_no,
+                    request_acks=batch.request_acks,
+                    digest=digest,
+                )
+            ),
+        )
+
+    def apply_forward_batch(
+        self, source: int, seq_no: int, digest: bytes, request_acks: list
+    ) -> Actions:
+        if digest not in self.fetch_in_flight:
+            return Actions()  # unsolicited; can't trust it
+        return Actions().hash(
+            [ack.digest for ack in request_acks],
+            pb.HashResult(
+                digest=b"",
+                type=pb.HashOriginVerifyBatch(
+                    source=source,
+                    seq_no=seq_no,
+                    request_acks=request_acks,
+                    expected_digest=digest,
+                ),
+            ),
+        )
+
+    def apply_verify_batch_hash_result(
+        self, digest: bytes, verify: pb.HashOriginVerifyBatch
+    ) -> None:
+        if verify.expected_digest != digest:
+            raise ByzantineBatchForward(
+                f"forwarded batch hashes to {digest!r}, "
+                f"claimed {verify.expected_digest!r}"
+            )
+        in_flight = self.fetch_in_flight.pop(digest, None)
+        if in_flight is None:
+            return  # duplicate response; already satisfied
+        batch = self.batches_by_digest.get(digest)
+        if batch is None:
+            batch = _Batch(verify.request_acks)
+            self.batches_by_digest[digest] = batch
+        batch.observed_sequences.update(in_flight)
+
+    def has_fetch_in_flight(self) -> bool:
+        return bool(self.fetch_in_flight)
+
+    def get_batch(self, digest: bytes) -> _Batch | None:
+        return self.batches_by_digest.get(digest)

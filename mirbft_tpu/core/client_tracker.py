@@ -1,0 +1,856 @@
+"""Client request tracking: windows, ACK certificates, replication.
+
+Rebuild of the reference's largest and subtlest component (reference:
+client_tracker.go:19-1267; the design essay at :19-115 is the spec).  In
+brief:
+
+- Requests enter either locally (Propose → verified → digest → ACK
+  broadcast) or via a weak quorum (f+1) of RequestAcks proving some correct
+  replica validated them.  A strong quorum (2f+1) makes a request safe to
+  propose.
+- Each client has a sliding window of request numbers [low_watermark,
+  low_watermark + width]; windows advance only at checkpoint boundaries,
+  with the *previous* checkpoint's width consumption throttling how much of
+  the new window is usable before the next checkpoint
+  (``valid_after_seq_no`` — see commits_completed_for_checkpoint_window).
+- A client observed submitting two distinct correct requests for one req_no
+  is (accidentally or deliberately) byzantine: replicas then advocate a
+  *null request* for that req_no, consuming it without committing data.
+- Correct-but-missing requests are fetched from their ackers after a few
+  ticks, refetched on timeout, and ACKs are rebroadcast with linear backoff
+  so a stalled client's request eventually reaches everyone.
+
+Deliberate deviations from the reference:
+- digests are replayed in true byte order on reinitialize (the reference's
+  comparator at client_tracker.go:759-761 compares indices, not values,
+  yielding map-order nondeterminism);
+- the committed-mask bit during window rebuild is read at
+  ``req_no - high_state.low_watermark`` — correct for any low/high state
+  pair, where the reference's ``i + high_offset`` (client_tracker.go:1109)
+  is only right because it always passes the same state for both;
+- a fully consumed client window is re-extended at the checkpoint boundary
+  instead of stalling (see Client.allocate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import pb
+from .actions import Actions
+from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
+from .persisted import Persisted
+from .preimage import request_hash_data
+from .quorum import bit_is_set, intersection_quorum, make_bitmask, set_bit, some_correct_quorum
+
+_NULL = b""  # digest key of the null request
+
+_CORRECT_FETCH_TICKS = 4
+_FETCH_TIMEOUT_TICKS = 4
+_ACK_RESEND_TICKS = 20
+
+
+# ---------------------------------------------------------------------------
+# Stable lists: append-only linked lists whose iterators survive removal of
+# elements by other iterators (reference: client_tracker.go:117-284).  The
+# proposer holds a long-lived iterator over the ready list across GC.
+# ---------------------------------------------------------------------------
+
+
+class _StableNode:
+    __slots__ = ("value", "next")
+
+    def __init__(self, value=None):
+        self.value = value
+        self.next = None
+
+
+_TOMBSTONE = object()
+
+
+class StableList:
+    """Singly linked append-only list.  Removal tombstones the node and
+    unlinks it: live iterators already holding the node keep walking its
+    ``next`` chain; fresh iterators never see it."""
+
+    def __init__(self):
+        self._head = _StableNode()  # sentinel
+        self._tail = self._head
+
+    def push_back(self, value) -> None:
+        node = _StableNode(value)
+        self._tail.next = node
+        self._tail = node
+
+    def iterator(self) -> "StableIterator":
+        return StableIterator(self, self._head)
+
+
+class StableIterator:
+    def __init__(self, lst: StableList, start: _StableNode):
+        self._list = lst
+        self._prev = start  # last node we returned (or sentinel)
+
+    def _peek(self):
+        node = self._prev.next
+        while node is not None and node.value is _TOMBSTONE:
+            node = node.next
+        return node
+
+    def has_next(self) -> bool:
+        return self._peek() is not None
+
+    def next(self):
+        node = self._peek()
+        if node is None:
+            raise StopIteration
+        self._prev = node
+        return node.value
+
+    def remove_last(self) -> None:
+        """Tombstone the element most recently returned by next()."""
+        self._prev.value = _TOMBSTONE
+
+
+class ReadyList:
+    """Requests with a strong cert that we hold locally, in discovery order
+    — the proposer's input queue."""
+
+    def __init__(self):
+        self._list = StableList()
+
+    def push_back(self, crn: "ClientReqNo") -> None:
+        self._list.push_back(crn)
+
+    def iterator(self) -> StableIterator:
+        return self._list.iterator()
+
+    def garbage_collect(self, seq_no: int) -> None:
+        it = self._list.iterator()
+        while it.has_next():
+            crn = it.next()
+            if crn.committed is not None and crn.committed <= seq_no:
+                it.remove_last()
+
+
+class AvailableList:
+    """Requests with f+1 ACKs whose data we hold (correct + persisted)."""
+
+    def __init__(self):
+        self._list = StableList()
+
+    def push_back(self, cr: "ClientRequest") -> None:
+        self._list.push_back(cr)
+
+    def iterator(self) -> StableIterator:
+        return self._list.iterator()
+
+    def garbage_collect(self, _seq_no: int) -> None:
+        it = self._list.iterator()
+        while it.has_next():
+            if it.next().garbage:
+                it.remove_last()
+
+
+# ---------------------------------------------------------------------------
+# Per-request state
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClientRequest:
+    ack: pb.RequestAck
+    agreements: set = field(default_factory=set)  # node IDs acking this digest
+    garbage: bool = False  # some request for this (client, req_no) committed
+    stored: bool = False  # persisted locally
+    fetching: bool = False
+    ticks_fetching: int = 0
+    ticks_correct: int = 0
+
+    def fetch(self) -> Actions:
+        if self.fetching:
+            return Actions()
+        self.fetching = True
+        self.ticks_fetching = 0
+        return Actions().send(
+            sorted(self.agreements),
+            pb.Msg(
+                type=pb.FetchRequest(
+                    client_id=self.ack.client_id,
+                    req_no=self.ack.req_no,
+                    digest=self.ack.digest,
+                )
+            ),
+        )
+
+
+class ClientReqNo:
+    """ACK accumulation and correctness determination for one (client,
+    req_no) (reference: client_tracker.go:711-1016; the doc comment there
+    explains the null-request byzantine fallback)."""
+
+    def __init__(
+        self,
+        client_id: int,
+        req_no: int,
+        valid_after_seq_no: int,
+        network_config: pb.NetworkConfig | None = None,
+        committed: int | None = None,
+    ):
+        self.client_id = client_id
+        self.req_no = req_no
+        self.valid_after_seq_no = valid_after_seq_no
+        self.network_config = network_config
+        self.committed = committed
+        self.non_null_voters: set = set()
+        self.requests: dict[bytes, ClientRequest] = {}  # all observed
+        self.weak_requests: dict[bytes, ClientRequest] = {}  # f+1 correct
+        self.strong_requests: dict[bytes, ClientRequest] = {}  # 2f+1
+        self.my_requests: dict[bytes, ClientRequest] = {}  # persisted locally
+        self.acks_sent = 0
+        self.ticks_since_ack = 0
+
+    def reinitialize(self, network_config: pb.NetworkConfig) -> None:
+        self.network_config = network_config
+        old_requests = self.requests
+        self.non_null_voters = set()
+        self.requests = {}
+        self.weak_requests = {}
+        self.strong_requests = {}
+        self.my_requests = {}
+
+        for digest in sorted(old_requests):
+            old_req = old_requests[digest]
+            for node_id in network_config.nodes:
+                if node_id in old_req.agreements:
+                    self.apply_request_ack(node_id, old_req.ack, force=True)
+            if old_req.stored:
+                new_req = self.client_req(old_req.ack)
+                new_req.stored = True
+                self.my_requests[digest] = new_req
+
+    def client_req(self, ack: pb.RequestAck) -> ClientRequest:
+        key = ack.digest or _NULL
+        req = self.requests.get(key)
+        if req is None:
+            req = ClientRequest(ack=ack)
+            self.requests[key] = req
+        return req
+
+    def apply_request_digest(self, ack: pb.RequestAck, data: bytes) -> Actions:
+        """Our own verified copy of the request (via Propose hash or a
+        verified forward): persist it and ACK it to the network."""
+        if ack.digest in self.my_requests:
+            # Race between a forward and a local proposal; already persisted.
+            return Actions()
+
+        req = self.client_req(ack)
+        req.stored = True
+        self.my_requests[ack.digest] = req
+
+        actions = Actions().store_request(
+            pb.ForwardRequest(request_ack=ack, request_data=data)
+        )
+
+        if len(self.my_requests) == 1:
+            self.acks_sent = 1
+            self.ticks_since_ack = 0
+            return actions.send(
+                self.network_config.nodes, pb.Msg(type=ack)
+            )
+
+        # Multiple distinct requests persisted → advocate the null request.
+        if _NULL in self.my_requests:
+            return actions  # already advocating
+
+        null_ack = pb.RequestAck(client_id=self.client_id, req_no=self.req_no)
+        null_req = self.client_req(null_ack)
+        null_req.stored = True
+        self.my_requests[_NULL] = null_req
+        self.acks_sent = 1
+        self.ticks_since_ack = 0
+        return actions.send(
+            self.network_config.nodes, pb.Msg(type=null_ack)
+        ).store_request(pb.ForwardRequest(request_ack=null_ack))
+
+    def apply_request_ack(
+        self, source: int, ack: pb.RequestAck, force: bool = False
+    ) -> None:
+        """Count one node's ACK.  A node gets one non-null vote ever (the
+        spam guard from the design essay — the reference documents this but
+        leaves its live path unguarded, client_tracker.go:379), except when
+        ``force`` marks the digest known-correct (weak quorum during
+        three-phase commit, or epoch change)."""
+        if ack.digest:
+            if not force and source in self.non_null_voters:
+                key = ack.digest
+                existing = self.requests.get(key)
+                if existing is None or source not in existing.agreements:
+                    return  # second distinct non-null vote: ignored
+            self.non_null_voters.add(source)
+
+        req = self.client_req(ack)
+        req.agreements.add(source)
+
+        if len(req.agreements) < some_correct_quorum(self.network_config):
+            return
+        self.weak_requests[ack.digest or _NULL] = req
+        if len(req.agreements) < intersection_quorum(self.network_config):
+            return
+        self.strong_requests[ack.digest or _NULL] = req
+
+    def tick(self) -> Actions:
+        if self.committed is not None:
+            return Actions()
+
+        actions = Actions()
+
+        # 1. Conflicting correct requests and no commit → promote null.
+        if _NULL not in self.my_requests and len(self.weak_requests) > 1:
+            null_ack = pb.RequestAck(
+                client_id=self.client_id, req_no=self.req_no
+            )
+            null_req = self.client_req(null_ack)
+            null_req.stored = True
+            self.my_requests[_NULL] = null_req
+            self.acks_sent = 1
+            self.ticks_since_ack = 0
+            actions.send(
+                self.network_config.nodes, pb.Msg(type=null_ack)
+            ).store_request(pb.ForwardRequest(request_ack=null_ack))
+
+        # 2. Exactly one correct request we don't hold: fetch it after a
+        # few ticks of patience.
+        if len(self.weak_requests) == 1:
+            (cr,) = self.weak_requests.values()
+            if not cr.stored and not cr.fetching:
+                if cr.ticks_correct <= _CORRECT_FETCH_TICKS:
+                    cr.ticks_correct += 1
+                else:
+                    actions.concat(cr.fetch())
+
+        # 3. Refetch correct requests whose fetch timed out.
+        to_fetch = []
+        for cr in self.weak_requests.values():
+            if not cr.fetching:
+                continue
+            if cr.ticks_fetching <= _FETCH_TIMEOUT_TICKS:
+                cr.ticks_fetching += 1
+                continue
+            cr.fetching = False
+            to_fetch.append(cr)
+        to_fetch.sort(key=lambda cr: cr.ack.digest, reverse=True)
+        for cr in to_fetch:
+            actions.concat(cr.fetch())
+
+        # 4. Rebroadcast our ACK with linear backoff.
+        if self.acks_sent == 0:
+            return actions
+        if self.ticks_since_ack != self.acks_sent * _ACK_RESEND_TICKS:
+            self.ticks_since_ack += 1
+            return actions
+
+        if len(self.my_requests) > 1:
+            ack = self.my_requests[_NULL].ack
+        elif len(self.my_requests) == 1:
+            (only,) = self.my_requests.values()
+            ack = only.ack
+        else:
+            raise AssertionError("acks sent but no request held")
+
+        self.acks_sent += 1
+        self.ticks_since_ack = 0
+        actions.send(self.network_config.nodes, pb.Msg(type=ack))
+        return actions
+
+
+# ---------------------------------------------------------------------------
+# Per-client window
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClientWaiter:
+    """Watermark snapshot the runtime uses to backpressure proposers; a new
+    waiter is issued whenever the window moves and the old one is marked
+    expired (the runtime layer maps this onto real synchronization)."""
+
+    low_watermark: int
+    high_watermark: int
+    expired: bool = False
+
+
+class Client:
+    def __init__(self, logger=None):
+        self.logger = logger
+        self.client_state: pb.NetworkClient | None = None
+        self.network_config: pb.NetworkConfig | None = None
+        self.low_watermark = 0
+        self.high_watermark = 0
+        self.next_ready_mark = 0
+        self.req_no_map: dict[int, ClientReqNo] = {}
+        self.client_waiter: ClientWaiter | None = None
+
+    def req_nos(self):
+        """All live ClientReqNos in req_no order."""
+        return [
+            self.req_no_map[r]
+            for r in range(self.low_watermark, self.high_watermark + 1)
+            if r in self.req_no_map
+        ]
+
+    def reinitialize(
+        self,
+        network_config: pb.NetworkConfig,
+        low_seq_no: int,
+        high_seq_no: int,
+        low_state: pb.NetworkClient,
+        high_state: pb.NetworkClient,
+    ) -> None:
+        """Rebuild the window from the low/high CEntry pair: [low_state's
+        watermark, +width], marking req_nos the high state knows committed
+        (below its watermark or set in its committed mask), and gating the
+        tail of the window (width consumed last checkpoint) on the next
+        checkpoint (reference: client_tracker.go:1081-1144)."""
+        low_watermark = low_state.low_watermark
+        width = low_state.width
+
+        old_map = self.req_no_map
+        self.client_state = high_state
+        self.network_config = network_config
+        self.low_watermark = low_watermark
+        self.high_watermark = low_watermark + width
+        self.next_ready_mark = low_watermark
+        self.req_no_map = {}
+        if self.client_waiter is not None:
+            self.client_waiter.expired = True
+        self.client_waiter = ClientWaiter(
+            low_watermark=self.low_watermark,
+            high_watermark=self.high_watermark,
+        )
+
+        for i in range(width + 1):
+            req_no = low_watermark + i
+
+            committed = None
+            # Fix vs reference (see module docstring): the high state's mask
+            # is indexed relative to the high state's own low watermark.
+            mask_idx = req_no - high_state.low_watermark
+            if req_no < high_state.low_watermark or (
+                mask_idx >= 0
+                and bit_is_set(high_state.committed_mask, mask_idx)
+            ):
+                committed = high_seq_no  # conservatively GC-able later
+
+            if i <= width - low_state.width_consumed_last_checkpoint:
+                valid_after = low_seq_no
+            else:
+                valid_after = low_seq_no + network_config.checkpoint_interval
+
+            crn = old_map.get(req_no)
+            if crn is not None:
+                crn.committed = committed
+            else:
+                crn = ClientReqNo(
+                    client_id=low_state.id,
+                    req_no=req_no,
+                    valid_after_seq_no=valid_after,
+                    committed=committed,
+                )
+            crn.reinitialize(network_config)
+            self.req_no_map[req_no] = crn
+
+    def allocate(self, starting_at_seq_no: int, state: pb.NetworkClient) -> None:
+        """Extend the window at a checkpoint boundary; the newly usable tail
+        only becomes proposable after the *next* checkpoint (reference:
+        client_tracker.go:1146-1175).  Allocation starts from our current
+        high watermark rather than the reference's intermediate-watermark
+        arithmetic: equivalent in the partial-commit case, and it also
+        re-extends a *fully* consumed window (where the reference stalls —
+        its all-committed branch at client_tracker.go:507-517 never
+        allocates, and its own assert would reject the state if it did)."""
+        new_high = state.low_watermark + state.width
+        if new_high < self.high_watermark:
+            raise AssertionError(
+                f"window must not shrink: new high {new_high} < current "
+                f"high {self.high_watermark}"
+            )
+
+        for req_no in range(self.high_watermark + 1, new_high + 1):
+            crn = ClientReqNo(
+                client_id=state.id,
+                req_no=req_no,
+                valid_after_seq_no=starting_at_seq_no
+                + self.network_config.checkpoint_interval,
+            )
+            crn.network_config = self.network_config
+            self.req_no_map[req_no] = crn
+
+        self.high_watermark = new_high
+        self.client_waiter.expired = True
+        self.client_waiter = ClientWaiter(
+            low_watermark=self.low_watermark,
+            high_watermark=self.high_watermark,
+        )
+
+    def move_low_watermark(self, max_seq_no: int) -> None:
+        for req_no in range(self.low_watermark, self.high_watermark + 1):
+            crn = self.req_no_map.get(req_no)
+            if crn is None:
+                continue
+            if crn.committed is None or crn.committed > max_seq_no:
+                break
+            if crn.req_no >= self.next_ready_mark:
+                # A request can commit without us ever marking it ready
+                # (it was correct elsewhere); move the mark *past* it — it is
+                # being garbage collected and can never become ready.  (The
+                # reference sets the mark to req_no itself,
+                # client_tracker.go:1187-1191, which strands the ready path
+                # one slot behind and trips advanceReady's missing-req
+                # assert after this entry is deleted.)
+                self.next_ready_mark = crn.req_no + 1
+            for cr in crn.requests.values():
+                cr.garbage = True
+            del self.req_no_map[req_no]
+        self.low_watermark = min(self.req_no_map) if self.req_no_map else (
+            self.high_watermark + 1
+        )
+
+    def ack(self, source: int, ack: pb.RequestAck):
+        crn = self.req_no_map.get(ack.req_no)
+        if crn is None:
+            raise AssertionError(
+                f"client {ack.client_id}: ack for req_no {ack.req_no} outside "
+                f"window [{self.low_watermark}, {self.high_watermark}]"
+            )
+        key = ack.digest or _NULL
+        was_weak = key in crn.weak_requests
+        crn.apply_request_ack(source, ack)
+        newly_correct = not was_weak and key in crn.weak_requests
+        return crn.requests.get(key), crn, newly_correct
+
+    def in_watermarks(self, req_no: int) -> bool:
+        return self.low_watermark <= req_no <= self.high_watermark
+
+    def req_no(self, req_no: int) -> ClientReqNo:
+        crn = self.req_no_map.get(req_no)
+        if crn is None:
+            raise AssertionError(f"req_no {req_no} not tracked")
+        return crn
+
+    def tick(self) -> Actions:
+        actions = Actions()
+        for crn in self.req_nos():
+            actions.concat(crn.tick())
+        return actions
+
+
+# ---------------------------------------------------------------------------
+# The tracker
+# ---------------------------------------------------------------------------
+
+
+class ClientTracker:
+    def __init__(
+        self,
+        persisted: Persisted,
+        node_buffers: NodeBuffers,
+        my_config: pb.InitialParameters,
+        logger=None,
+    ):
+        self.persisted = persisted
+        self.node_buffers = node_buffers
+        self.my_config = my_config
+        self.logger = logger
+
+        self.clients: dict[int, Client] = {}
+        self.client_states: list = []
+        self.network_config: pb.NetworkConfig | None = None
+        self.msg_buffers: dict[int, MsgBuffer] = {}
+        self.ready_list = ReadyList()
+        self.available_list = AvailableList()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reinitialize(self) -> None:
+        low_c = high_c = None
+
+        def on_c(c_entry):
+            nonlocal low_c, high_c
+            if low_c is None:
+                low_c = c_entry
+            high_c = c_entry
+
+        self.persisted.iterate({pb.CEntry: on_c})
+        if low_c is None:
+            raise AssertionError("log must contain a checkpoint")
+
+        latest_states = {cs.id: cs for cs in high_c.network_state.clients}
+
+        self.network_config = low_c.network_state.config
+        self.available_list = AvailableList()
+        self.ready_list = ReadyList()
+
+        old_clients = self.clients
+        self.clients = {}
+        self.client_states = high_c.network_state.clients
+        for client_state in self.client_states:
+            client = old_clients.get(client_state.id) or Client(self.logger)
+            self.clients[client_state.id] = client
+            client.reinitialize(
+                low_c.network_state.config,
+                low_c.seq_no,
+                high_c.seq_no,
+                client_state,
+                latest_states[client_state.id],
+            )
+            self.advance_ready(client)
+
+        old_buffers = self.msg_buffers
+        self.msg_buffers = {}
+        for node_id in low_c.network_state.config.nodes:
+            buffer = old_buffers.get(node_id)
+            if buffer is None:
+                buffer = MsgBuffer(
+                    "clients", self.node_buffers.node_buffer(node_id)
+                )
+            self.msg_buffers[node_id] = buffer
+
+    def tick(self) -> Actions:
+        actions = Actions()
+        for client_state in self.client_states:
+            actions.concat(self.clients[client_state.id].tick())
+        return actions
+
+    # -- message handling ----------------------------------------------------
+
+    def filter(self, _source: int, msg: pb.Msg) -> Applyable:
+        inner = msg.type
+        if isinstance(inner, pb.RequestAck):
+            ack = inner
+        elif isinstance(inner, pb.ForwardRequest):
+            ack = inner.request_ack
+        elif isinstance(inner, pb.FetchRequest):
+            return Applyable.CURRENT
+        else:
+            raise AssertionError(
+                f"unexpected client message {type(inner).__name__}"
+            )
+        client = self.clients.get(ack.client_id)
+        if client is None:
+            return Applyable.FUTURE  # client may appear via reconfiguration
+        if client.low_watermark > ack.req_no:
+            return Applyable.PAST
+        if client.high_watermark < ack.req_no:
+            return Applyable.FUTURE
+        return Applyable.CURRENT
+
+    def step(self, source: int, msg: pb.Msg) -> Actions:
+        verdict = self.filter(source, msg)
+        if verdict is Applyable.PAST:
+            return Actions()
+        if verdict is Applyable.FUTURE:
+            self.msg_buffers[source].store(msg)
+            return Actions()
+        return self.apply_msg(source, msg)
+
+    def apply_msg(self, source: int, msg: pb.Msg) -> Actions:
+        inner = msg.type
+        if isinstance(inner, pb.RequestAck):
+            self.ack(source, inner)
+            return Actions()
+        if isinstance(inner, pb.FetchRequest):
+            return self.reply_fetch_request(
+                source, inner.client_id, inner.req_no, inner.digest
+            )
+        if isinstance(inner, pb.ForwardRequest):
+            if source == self.my_config.id:
+                return Actions()  # our own forward, already processed
+            return self.apply_forward_request(source, inner)
+        raise AssertionError(f"unexpected client message {type(inner).__name__}")
+
+    # -- request arrival paths ----------------------------------------------
+
+    def apply_request_digest(self, ack: pb.RequestAck, data: bytes) -> Actions:
+        client = self.clients.get(ack.client_id)
+        if client is None:
+            return Actions()  # client removed since the request was hashed
+        if not client.in_watermarks(ack.req_no):
+            return Actions()  # already committed / out of window
+        return client.req_no(ack.req_no).apply_request_digest(ack, data)
+
+    def reply_fetch_request(
+        self, source: int, client_id: int, req_no: int, digest: bytes
+    ) -> Actions:
+        client = self.clients.get(client_id)
+        if client is None or not client.in_watermarks(req_no):
+            return Actions()
+        crn = client.req_no(req_no)
+        req = crn.requests.get(digest or _NULL)
+        if req is None or self.my_config.id not in req.agreements:
+            return Actions()
+        return Actions().forward_request(
+            [source],
+            pb.RequestAck(client_id=client_id, req_no=req_no, digest=digest),
+        )
+
+    def apply_forward_request(
+        self, source: int, msg: pb.ForwardRequest
+    ) -> Actions:
+        client = self.clients.get(msg.request_ack.client_id)
+        if client is None:
+            return Actions()
+        crn = client.req_no(msg.request_ack.req_no)
+        req = crn.requests.get(msg.request_ack.digest or _NULL)
+        if req is None:
+            # We don't know this digest to be correct yet; drop (the weak
+            # quorum will trigger a fetch if it becomes correct).
+            return Actions()
+        if self.my_config.id in req.agreements:
+            return Actions()  # we already hold + acked it
+        req.agreements.add(source)
+        return Actions().hash(
+            request_hash_data(
+                pb.Request(
+                    client_id=msg.request_ack.client_id,
+                    req_no=msg.request_ack.req_no,
+                    data=msg.request_data,
+                )
+            ),
+            pb.HashResult(
+                digest=b"",
+                type=pb.HashOriginVerifyRequest(
+                    source=source,
+                    request_ack=msg.request_ack,
+                    request_data=msg.request_data,
+                ),
+            ),
+        )
+
+    # -- ack accounting ------------------------------------------------------
+
+    def ack(self, source: int, ack: pb.RequestAck) -> ClientRequest:
+        client = self.clients.get(ack.client_id)
+        if client is None:
+            raise AssertionError("step filter must delay unknown clients")
+        cr, crn, newly_correct = client.ack(source, ack)
+        if newly_correct:
+            self.available_list.push_back(cr)
+        self.check_ready(client, crn)
+        return cr
+
+    def check_ready(self, client: Client, crn: ClientReqNo) -> None:
+        if crn.req_no != client.next_ready_mark:
+            return
+        if not crn.strong_requests:
+            return
+        for digest in crn.strong_requests:
+            if digest in crn.my_requests:
+                self.advance_ready(client)
+                return
+
+    def advance_ready(self, client: Client) -> None:
+        for req_no in range(client.next_ready_mark, client.high_watermark + 1):
+            if req_no != client.next_ready_mark:
+                return  # previous iteration failed to advance
+            crn = client.req_no_map.get(req_no)
+            if crn is None:
+                raise AssertionError(
+                    f"client {client.client_state.id} missing req_no {req_no}"
+                )
+            for digest in crn.strong_requests:
+                if digest in crn.my_requests:
+                    self.ready_list.push_back(crn)
+                    client.next_ready_mark = req_no + 1
+                    break
+
+    # -- checkpoint interplay ------------------------------------------------
+
+    def commits_completed_for_checkpoint_window(self, seq_no: int) -> list:
+        """Compute each client's next window state at a checkpoint boundary
+        and allocate the newly usable request numbers (reference:
+        client_tracker.go:482-550; the doc comment there works the
+        width-consumed example)."""
+        new_states = []
+        for old_state in self.client_states:
+            client = self.clients[old_state.id]
+
+            first_uncommitted = last_committed = None
+            for crn in client.req_nos():
+                if crn.committed is not None:
+                    if crn.committed > seq_no:
+                        raise AssertionError(
+                            "commit sequence after current checkpoint"
+                        )
+                    last_committed = crn.req_no
+                elif first_uncommitted is None:
+                    first_uncommitted = crn.req_no
+
+            if last_committed is None:
+                new_states.append(old_state)
+                continue
+
+            if first_uncommitted is None:
+                if last_committed != client.high_watermark:
+                    raise AssertionError(
+                        "all committed implies committed through high mark"
+                    )
+                # Entire window consumed: the whole next window is gated on
+                # the next checkpoint (width_consumed = full width).
+                state = pb.NetworkClient(
+                    id=old_state.id,
+                    width=old_state.width,
+                    width_consumed_last_checkpoint=old_state.width,
+                    low_watermark=last_committed + 1,
+                )
+                new_states.append(state)
+                client.allocate(seq_no, state)
+                continue
+
+            mask = make_bitmask(last_committed - first_uncommitted + 1)
+            for i in range(last_committed - first_uncommitted + 1):
+                req_no = first_uncommitted + i
+                if client.req_no(req_no).committed is None:
+                    continue
+                if i == 0:
+                    raise AssertionError(
+                        "first uncommitted cannot be committed"
+                    )
+                set_bit(mask, i)
+
+            state = pb.NetworkClient(
+                id=old_state.id,
+                width=old_state.width,
+                width_consumed_last_checkpoint=first_uncommitted
+                - old_state.low_watermark,
+                low_watermark=first_uncommitted,
+                committed_mask=bytes(mask),
+            )
+            new_states.append(state)
+            client.allocate(seq_no, state)
+
+        self.client_states = new_states
+        return new_states
+
+    def drain(self) -> Actions:
+        """Re-apply buffered messages after watermark movement."""
+        actions = Actions()
+        for node_id in self.network_config.nodes:
+            self.msg_buffers[node_id].iterate(
+                self.filter,
+                lambda source, msg: actions.concat(self.apply_msg(source, msg)),
+            )
+        return actions
+
+    def mark_committed(self, client_id: int, req_no: int, seq_no: int) -> None:
+        """Called by commit state as batches are applied."""
+        self.clients[client_id].req_no(req_no).committed = seq_no
+
+    def garbage_collect(self, seq_no: int) -> None:
+        for client_state in self.client_states:
+            self.clients[client_state.id].move_low_watermark(seq_no)
+        self.available_list.garbage_collect(seq_no)
+        self.ready_list.garbage_collect(seq_no)
+
+    def client(self, client_id: int) -> Client | None:
+        return self.clients.get(client_id)

@@ -1,0 +1,125 @@
+"""Batch assembly for the buckets this node leads.
+
+Rebuild of the reference's proposer (reference: proposer.go:22-159).  The
+proposer drains the client tracker's ready list (strongly certified requests
+we hold locally) into per-owned-bucket queues, gated by each request's
+``valid_after_seq_no`` — requests in the tail of a client's window only
+become proposable after the next checkpoint (the readyList/nextReadyList
+swap).  The active epoch cuts a batch when BatchSize requests are pending
+(or any are pending, for heartbeat flushes).
+"""
+
+from __future__ import annotations
+
+from .. import pb
+from .client_tracker import ClientTracker
+from .quorum import req_bucket
+
+_NULL = b""
+
+
+class ProposalBucket:
+    def __init__(
+        self,
+        bucket_id: int,
+        base_checkpoint: int,
+        checkpoint_interval: int,
+        batch_size: int,
+    ):
+        self.bucket_id = bucket_id
+        self.checkpoint_interval = checkpoint_interval
+        self.batch_size = batch_size
+        # Advanced as the caller's sequence number crosses checkpoints; the
+        # next_ready queue unlocks one checkpoint interval at a time.
+        self.current_checkpoint = base_checkpoint
+        self.ready: list = []  # proposable now
+        self.next_ready: list = []  # proposable after the next checkpoint
+        self.pending: list = []  # accumulating batch
+
+    def queue_request(self, valid_after_seq_no: int, cr) -> None:
+        if self.current_checkpoint >= valid_after_seq_no:
+            self.ready.append(cr)
+        else:
+            if valid_after_seq_no != self.current_checkpoint + self.checkpoint_interval:
+                raise AssertionError(
+                    "requests never become ready beyond the next checkpoint"
+                )
+            self.next_ready.append(cr)
+
+    def advance(self, to_seq_no: int) -> None:
+        if to_seq_no >= self.current_checkpoint + self.checkpoint_interval:
+            self.current_checkpoint += self.checkpoint_interval
+            self.ready.extend(self.next_ready)
+            self.next_ready = []
+        while len(self.pending) < self.batch_size and self.ready:
+            self.pending.append(self.ready.pop(0))
+
+    def has_outstanding(self, for_seq_no: int) -> bool:
+        """Anything at all to propose (heartbeat flush)."""
+        self.advance(for_seq_no)
+        return len(self.pending) > 0
+
+    def has_pending(self, for_seq_no: int) -> bool:
+        """A full batch to propose."""
+        self.advance(for_seq_no)
+        return 0 < len(self.pending) == self.batch_size
+
+    def next_batch(self) -> list:
+        result = self.pending
+        self.pending = []
+        return result
+
+
+class Proposer:
+    def __init__(
+        self,
+        base_checkpoint: int,
+        checkpoint_interval: int,
+        my_config: pb.InitialParameters,
+        client_tracker: ClientTracker,
+        buckets: dict,  # bucket_id -> leader node_id
+    ):
+        self.my_config = my_config
+        self.total_buckets = len(buckets)
+        self.proposal_buckets = {
+            bucket_id: ProposalBucket(
+                bucket_id=bucket_id,
+                base_checkpoint=base_checkpoint,
+                checkpoint_interval=checkpoint_interval,
+                batch_size=my_config.batch_size,
+            )
+            for bucket_id, leader in buckets.items()
+            if leader == my_config.id
+        }
+        self.ready_iterator = client_tracker.ready_list.iterator()
+
+    def advance(self, to_seq_no: int) -> None:
+        """Drain newly ready requests into our buckets' queues."""
+        while self.ready_iterator.has_next():
+            crn = self.ready_iterator.next()
+            if crn.committed is not None:
+                # Committed in a previous view but not yet GC'd.
+                continue
+
+            bucket_id = req_bucket(crn.client_id, crn.req_no, self.total_buckets)
+            bucket = self.proposal_buckets.get(bucket_id)
+            if bucket is None:
+                continue  # not ours to propose
+
+            bucket.advance(to_seq_no)
+
+            if len(crn.strong_requests) > 1:
+                null_req = crn.strong_requests.get(_NULL)
+                if null_req is None:
+                    raise AssertionError(
+                        "multiple strong requests require a null request"
+                    )
+                bucket.queue_request(crn.valid_after_seq_no, null_req)
+            else:
+                if len(crn.strong_requests) != 1:
+                    raise AssertionError("exactly one strong request expected")
+                (only,) = crn.strong_requests.values()
+                bucket.queue_request(crn.valid_after_seq_no, only)
+
+    def proposal_bucket(self, bucket_id: int) -> ProposalBucket | None:
+        return self.proposal_buckets.get(bucket_id)

@@ -33,8 +33,12 @@ def some_correct_quorum(config: pb.NetworkConfig) -> int:
 # ---------------------------------------------------------------------------
 
 
+def req_bucket(client_id: int, req_no: int, num_buckets: int) -> int:
+    return (client_id + req_no) % num_buckets
+
+
 def client_req_to_bucket(client_id: int, req_no: int, config: pb.NetworkConfig) -> int:
-    return (client_id + req_no) % config.number_of_buckets
+    return req_bucket(client_id, req_no, config.number_of_buckets)
 
 
 def seq_to_bucket(seq_no: int, config: pb.NetworkConfig) -> int:

@@ -35,7 +35,6 @@ def sha256_pad(message: bytes) -> bytes:
 class PreimageBatch:
     blocks: np.ndarray  # (batch, max_blocks, 16) uint32 big-endian words
     n_blocks: np.ndarray  # (batch,) int32
-    position: list  # original message index -> row in blocks
 
 
 def pack_preimages(
@@ -43,12 +42,18 @@ def pack_preimages(
     block_floor: int = 1,
     batch_floor: int = 8,
 ) -> PreimageBatch:
-    """Pack byte strings into a bucketed, padded uint32 block tensor."""
+    """Pack byte strings into a bucketed, padded uint32 block tensor.
+
+    The batch axis is rounded to a power of two and then up to a multiple of
+    ``batch_floor`` — callers sharding over an n-device mesh pass
+    batch_floor=n so shard_map's even-split requirement holds for any mesh
+    size, not just powers of two."""
     padded = [sha256_pad(m) for m in messages]
     counts = [len(p) // 64 for p in padded]
 
     max_blocks = next_pow2(max(counts), block_floor)
-    batch = next_pow2(len(messages), batch_floor)
+    batch = next_pow2(len(messages))
+    batch += (-batch) % batch_floor
 
     buf = np.zeros((batch, max_blocks * 64), dtype=np.uint8)
     for i, p in enumerate(padded):
@@ -62,8 +67,4 @@ def pack_preimages(
     n_blocks = np.zeros(batch, dtype=np.int32)
     n_blocks[: len(counts)] = counts
 
-    return PreimageBatch(
-        blocks=blocks,
-        n_blocks=n_blocks,
-        position=list(range(len(messages))),
-    )
+    return PreimageBatch(blocks=blocks, n_blocks=n_blocks)

@@ -139,16 +139,25 @@ def sha256(message: bytes) -> bytes:
 def sha256_many(messages: list) -> list:
     """Digest a list of byte strings on the accelerator, preserving order.
 
-    Messages are bucketed by padded block count so only a few shapes ever
-    compile; each bucket is one kernel launch."""
-    from .batching import pack_preimages  # local import to avoid cycle
+    Messages are grouped by power-of-two padded block count, one kernel
+    launch per group: only a few shapes ever compile, and a single long
+    message doesn't force every short row through its block count."""
+    from .batching import next_pow2, pack_preimages, sha256_pad
 
     if not messages:
         return []
-    batch = pack_preimages(messages)
-    words = sha256_digest_words(batch.blocks, batch.n_blocks)
-    raw = np.asarray(words).astype(">u4").tobytes()
-    return [
-        raw[32 * batch.position[i] : 32 * batch.position[i] + 32]
-        for i in range(len(messages))
-    ]
+
+    groups: dict[int, list] = {}  # block bucket -> original indices
+    for i, msg in enumerate(messages):
+        bucket = next_pow2((len(sha256_pad(msg)) // 64))
+        groups.setdefault(bucket, []).append(i)
+
+    out: list = [None] * len(messages)
+    for bucket in sorted(groups):
+        indices = groups[bucket]
+        batch = pack_preimages([messages[i] for i in indices])
+        words = sha256_digest_words(batch.blocks, batch.n_blocks)
+        raw = np.asarray(words).astype(">u4").tobytes()
+        for row, i in enumerate(indices):
+            out[i] = raw[32 * row : 32 * row + 32]
+    return out

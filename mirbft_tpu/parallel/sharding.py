@@ -92,16 +92,18 @@ def sharded_quorum_tally(mesh: Mesh):
         total = jax.lax.psum(local, AXIS)
         return total >= threshold
 
-    fn = jax.shard_map(
-        tally_local,
-        mesh=mesh,
-        in_specs=(P(AXIS, None), P()),
-        out_specs=P(),
+    fn = jax.jit(
+        jax.shard_map(
+            tally_local,
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P()),
+            out_specs=P(),
+        )
     )
 
     def run(votes, threshold):
         votes = jnp.asarray(votes)
         threshold = jnp.asarray(threshold, dtype=jnp.int32)
-        return jax.jit(fn)(votes, threshold)
+        return fn(votes, threshold)
 
     return run

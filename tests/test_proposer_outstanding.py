@@ -1,0 +1,245 @@
+"""Gates for proposer batching (valid-after gating, null preference),
+the outstanding-reqs in-order checker, and the batch tracker fetch path."""
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core.batch_tracker import BatchTracker, ByzantineBatchForward
+from mirbft_tpu.core.client_tracker import ClientTracker
+from mirbft_tpu.core.msgbuffers import NodeBuffers
+from mirbft_tpu.core.outstanding import InvalidPreprepare, OutstandingReqs
+from mirbft_tpu.core.persisted import Persisted
+from mirbft_tpu.core.preimage import host_digest, request_hash_data
+from mirbft_tpu.core.proposer import Proposer
+from mirbft_tpu.core.sequence import Sequence, SeqState
+
+
+def network_state(n=4, f=1, ci=5, buckets=2, clients=((7, 20),)):
+    return pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(n)),
+            f=f,
+            number_of_buckets=buckets,
+            checkpoint_interval=ci,
+            max_epoch_length=50,
+        ),
+        clients=[
+            pb.NetworkClient(id=cid, width=w, low_watermark=0)
+            for cid, w in clients
+        ],
+    )
+
+
+def make_tracker(state):
+    persisted = Persisted()
+    persisted.add_c_entry(
+        pb.CEntry(seq_no=0, checkpoint_value=b"g", network_state=state)
+    )
+    my = pb.InitialParameters(id=0, batch_size=2, buffer_size=1 << 20)
+    ct = ClientTracker(persisted, NodeBuffers(my), my)
+    ct.reinitialize()
+    return ct, my, persisted
+
+
+def make_ready(ct, client_id, req_no, data=b"tx"):
+    """Run a request through propose + acks until it's on the ready list."""
+    r = pb.Request(client_id=client_id, req_no=req_no, data=data)
+    ack = pb.RequestAck(
+        client_id=client_id,
+        req_no=req_no,
+        digest=host_digest(request_hash_data(r)),
+    )
+    ct.apply_request_digest(ack, r.data)
+    for node in (0, 1, 2):
+        ct.step(node, pb.Msg(type=ack))
+    return ack
+
+
+def test_proposer_only_owns_my_buckets():
+    state = network_state()
+    ct, my, _ = make_tracker(state)
+    proposer = Proposer(0, 5, my, ct, buckets={0: 0, 1: 1})
+    assert set(proposer.proposal_buckets) == {0}
+
+
+def test_proposer_batches_in_bucket_order():
+    state = network_state()
+    ct, my, _ = make_tracker(state)
+    # client 7: req_no r -> bucket (7 + r) % 2 -> odd reqs to bucket 0.
+    proposer = Proposer(0, 5, my, ct, buckets={0: 0, 1: 1})
+    for rn in range(4):
+        make_ready(ct, 7, rn)
+    proposer.advance(1)
+    bucket = proposer.proposal_bucket(0)
+    assert bucket.has_pending(1)  # batch_size=2: reqs 1 and 3
+    batch = bucket.next_batch()
+    assert [cr.ack.req_no for cr in batch] == [1, 3]
+    assert not bucket.has_outstanding(1)
+
+
+def test_proposer_valid_after_gating():
+    state = network_state(clients=((7, 4),))
+    ct, my, _ = make_tracker(state)
+    # Fully commit the first window (0..4) through the seq-5 checkpoint.
+    for rn in range(5):
+        ct.mark_committed(7, rn, rn + 1)
+    ct.commits_completed_for_checkpoint_window(5)
+    ct.garbage_collect(5)
+    # Newly allocated reqs 5, 6 are valid only after seq 10 (5 + ci).
+    make_ready(ct, 7, 5)
+    make_ready(ct, 7, 6)
+    proposer = Proposer(5, 5, my, ct, buckets={0: 0, 1: 0})
+    b5 = proposer.proposal_bucket((7 + 5) % 2)
+    b6 = proposer.proposal_bucket((7 + 6) % 2)
+    proposer.advance(6)  # still inside the checkpoint window ending at 10
+    assert not b5.has_outstanding(6) and not b6.has_outstanding(6)
+    # Crossing the checkpoint boundary unlocks them.
+    assert b5.has_outstanding(10) and b6.has_outstanding(10)
+    assert [cr.ack.req_no for cr in b5.next_batch()] == [5]
+    assert [cr.ack.req_no for cr in b6.next_batch()] == [6]
+
+
+def test_proposer_prefers_null_on_conflict():
+    state = network_state(buckets=1)
+    ct, my, _ = make_tracker(state)
+    r_a = pb.Request(client_id=7, req_no=0, data=b"a")
+    ack_a = pb.RequestAck(
+        client_id=7, req_no=0, digest=host_digest(request_hash_data(r_a))
+    )
+    null_ack = pb.RequestAck(client_id=7, req_no=0)
+    ct.apply_request_digest(ack_a, r_a.data)
+    # Strong cert for BOTH the real request and the null request.
+    for node in (0, 1, 2):
+        ct.step(node, pb.Msg(type=ack_a))
+    crn = ct.client(7).req_no(0)
+    for node in (0, 1, 2):
+        crn.apply_request_ack(node, null_ack)
+    crn.my_requests[b""] = crn.client_req(null_ack)
+    proposer = Proposer(0, 5, my, ct, buckets={0: 0})
+    proposer.advance(1)
+    bucket = proposer.proposal_bucket(0)
+    assert bucket.has_outstanding(1)  # fills pending from the ready queue
+    batch = bucket.next_batch()
+    assert [cr.ack.digest for cr in batch] == [b""]
+
+
+def test_outstanding_enforces_client_order():
+    state = network_state(buckets=1)
+    ct, my, persisted = make_tracker(state)
+    outstanding = OutstandingReqs(ct, state)
+    seq = Sequence(
+        owner=1,
+        epoch=0,
+        seq_no=1,
+        persisted=persisted,
+        network_config=state.config,
+        my_config=my,
+    )
+    ack1 = pb.RequestAck(client_id=7, req_no=1, digest=b"d1")
+    with pytest.raises(InvalidPreprepare):
+        outstanding.apply_acks(0, seq, [ack1])  # req 0 must come first
+
+
+def test_outstanding_waits_for_unavailable_request():
+    state = network_state(buckets=1)
+    ct, my, persisted = make_tracker(state)
+    outstanding = OutstandingReqs(ct, state)
+    seq = Sequence(
+        owner=1,
+        epoch=0,
+        seq_no=1,
+        persisted=persisted,
+        network_config=state.config,
+        my_config=my,
+    )
+    r = pb.Request(client_id=7, req_no=0, data=b"tx")
+    ack = pb.RequestAck(
+        client_id=7, req_no=0, digest=host_digest(request_hash_data(r))
+    )
+    actions = outstanding.apply_acks(0, seq, [ack])
+    # The request is unknown: sequence allocated but pending the request.
+    assert seq.state == SeqState.PENDING_REQUESTS
+    seq.apply_batch_hash_result(b"batch-digest")
+    assert seq.state == SeqState.PENDING_REQUESTS
+    # Now the request becomes available (weak quorum + stored).
+    ct.apply_request_digest(ack, r.data)
+    ct.step(1, pb.Msg(type=ack))
+    ct.step(2, pb.Msg(type=ack))
+    actions = outstanding.advance_requests()
+    assert seq.state == SeqState.PREPREPARED
+    [send] = actions.sends
+    assert isinstance(send.msg.type, pb.Prepare)
+
+
+def test_outstanding_skips_committed_reqnos():
+    state = network_state(buckets=1)
+    ct, my, persisted = make_tracker(state)
+    ct.mark_committed(7, 0, 1)
+    outstanding = OutstandingReqs(ct, state)
+    cursor = outstanding.buckets[0][7]
+    assert cursor.next_req_no == 1  # skipped committed 0
+
+
+def test_batch_tracker_fetch_verify_cycle():
+    persisted = Persisted()
+    bt = BatchTracker(persisted)
+    acks = [pb.RequestAck(client_id=7, req_no=0, digest=b"\xaa" * 32)]
+    digest = host_digest([a.digest for a in acks])
+
+    actions = bt.fetch_batch(5, digest, [1, 2])
+    [send] = actions.sends
+    assert isinstance(send.msg.type, pb.FetchBatch)
+    # Duplicate fetch for same (seq, digest) suppressed.
+    assert bt.fetch_batch(5, digest, [1, 2]).is_empty()
+
+    # Unsolicited forward dropped.
+    assert bt.apply_forward_batch(2, 5, b"other", acks).is_empty()
+
+    actions = bt.apply_forward_batch(2, 5, digest, acks)
+    [hr] = actions.hashes
+    assert isinstance(hr.origin.type, pb.HashOriginVerifyBatch)
+
+    bt.apply_verify_batch_hash_result(digest, hr.origin.type)
+    assert not bt.has_fetch_in_flight()
+    assert bt.get_batch(digest) is not None
+    assert 5 in bt.get_batch(digest).observed_sequences
+
+    with pytest.raises(ByzantineBatchForward):
+        bt.apply_verify_batch_hash_result(
+            b"wrong",
+            pb.HashOriginVerifyBatch(expected_digest=digest, request_acks=acks),
+        )
+
+
+def test_batch_tracker_reinit_and_truncate():
+    persisted = Persisted()
+    bt = BatchTracker(persisted)
+    persisted.add_c_entry(
+        pb.CEntry(
+            seq_no=0,
+            checkpoint_value=b"g",
+            network_state=network_state(),
+        )
+    )
+    persisted.add_q_entry(pb.QEntry(seq_no=1, digest=b"d1", requests=[]))
+    persisted.add_q_entry(pb.QEntry(seq_no=2, digest=b"d2", requests=[]))
+    bt.reinitialize()
+    assert bt.get_batch(b"d1") and bt.get_batch(b"d2")
+    bt.truncate(2)
+    assert bt.get_batch(b"d1") is None
+    assert bt.get_batch(b"d2") is not None
+
+
+def test_batch_tracker_replies_to_fetch():
+    persisted = Persisted()
+    bt = BatchTracker(persisted)
+    acks = [pb.RequestAck(client_id=7, req_no=0, digest=b"x")]
+    bt.add_batch(3, b"bd", acks)
+    actions = bt.reply_fetch_batch(2, 3, b"bd")
+    [send] = actions.sends
+    assert send.targets == [2]
+    fwd = send.msg.type
+    assert isinstance(fwd, pb.ForwardBatch)
+    assert fwd.request_acks == acks
+    # Unknown digest: silently ignored.
+    assert bt.reply_fetch_batch(2, 3, b"unknown").is_empty()
