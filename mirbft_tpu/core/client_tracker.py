@@ -516,7 +516,9 @@ class Client:
             self.high_watermark + 1
         )
 
-    def ack(self, source: int, ack: pb.RequestAck):
+    def ack(self, source: int, ack: pb.RequestAck, force: bool = False):
+        """``force`` marks the digest known-correct (epoch-change batch
+        selection), bypassing the one-non-null-vote spam guard."""
         crn = self.req_no_map.get(ack.req_no)
         if crn is None:
             raise AssertionError(
@@ -525,7 +527,7 @@ class Client:
             )
         key = ack.digest or _NULL
         was_weak = key in crn.weak_requests
-        crn.apply_request_ack(source, ack)
+        crn.apply_request_ack(source, ack, force=force)
         newly_correct = not was_weak and key in crn.weak_requests
         return crn.requests.get(key), crn, newly_correct
 
@@ -729,11 +731,11 @@ class ClientTracker:
 
     # -- ack accounting ------------------------------------------------------
 
-    def ack(self, source: int, ack: pb.RequestAck) -> ClientRequest:
+    def ack(self, source: int, ack: pb.RequestAck, force: bool = False) -> ClientRequest:
         client = self.clients.get(ack.client_id)
         if client is None:
             raise AssertionError("step filter must delay unknown clients")
-        cr, crn, newly_correct = client.ack(source, ack)
+        cr, crn, newly_correct = client.ack(source, ack, force=force)
         if newly_correct:
             self.available_list.push_back(cr)
         self.check_ready(client, crn)

@@ -67,6 +67,7 @@ class CommitState:
         self.upper_half: list = []
         self.checkpoint_pending = False
         self.transferring = False
+        self.transfer_target: StateTarget | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -115,20 +116,31 @@ class CommitState:
 
         # Crashed mid state-transfer: resume it.
         self.transferring = True
-        actions = Actions()
-        actions.state_transfer = StateTarget(
+        self.transfer_target = StateTarget(
             seq_no=last_t.seq_no, value=last_t.value
         )
+        actions = Actions()
+        actions.state_transfer = self.transfer_target
         return actions
 
     def transfer_to(self, seq_no: int, value: bytes) -> Actions:
         if self.transferring:
             raise AssertionError("concurrent state transfers not supported")
         self.transferring = True
+        self.transfer_target = StateTarget(seq_no=seq_no, value=value)
         actions = self.persisted.add_t_entry(
             pb.TEntry(seq_no=seq_no, value=value)
         )
-        actions.state_transfer = StateTarget(seq_no=seq_no, value=value)
+        actions.state_transfer = self.transfer_target
+        return actions
+
+    def retry_transfer(self) -> Actions:
+        """Re-request the in-flight transfer after the consumer reported
+        failure (the target may have been garbage collected everywhere)."""
+        if not self.transferring or self.transfer_target is None:
+            raise AssertionError("no transfer in flight to retry")
+        actions = Actions()
+        actions.state_transfer = self.transfer_target
         return actions
 
     # -- checkpoint results --------------------------------------------------

@@ -1,0 +1,591 @@
+"""The view-change FSM: negotiating, verifying, and activating one epoch.
+
+Rebuild of the reference's epoch target (reference: epoch_target.go:20-766).
+State flow:
+
+    PREPENDING  sent our EpochChange, collecting a quorum of strong-certified
+                changes
+    PENDING     quorum reached; leader computed/sent NewEpoch, others await it
+    VERIFYING   got the leader's NewEpoch; recompute the config from the
+                referenced changes and compare (byzantine-leader check)
+    FETCHING    valid NewEpoch; fetch missing batches/requests it references
+    ECHOING     state held; persisted NEntry/QEntries; Bracha echo broadcast
+    READYING    echo quorum; persisted PEntries; Bracha ready broadcast
+    RESUMING    ready quorum (or crash-resume); waiting for the commit state
+                to line up with the epoch's starting sequence
+    READY       commit state aligned; instantiate the active epoch
+    IN_PROGRESS normal-case ordering (active_epoch)
+    ENDING/DONE gracefully ended at planned expiration / ended by suspicion
+
+The Bracha echo/ready broadcast of the NewEpochConfig is what makes epoch
+activation reliable despite a byzantine leader.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .. import pb
+from .actions import Actions
+from .active_epoch import ActiveEpoch
+from .batch_tracker import BatchTracker
+from .client_tracker import ClientTracker
+from .commitstate import CommitState
+from .epoch_change import EpochChangeCert, ParsedEpochChange
+from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
+from .persisted import Persisted
+from .quorum import (
+    construct_new_epoch_config,
+    intersection_quorum,
+    some_correct_quorum,
+)
+
+
+class TargetState(enum.IntEnum):
+    PREPENDING = 0
+    PENDING = 1
+    VERIFYING = 2
+    FETCHING = 3
+    ECHOING = 4
+    READYING = 5
+    RESUMING = 6
+    READY = 7
+    IN_PROGRESS = 8
+    ENDING = 9
+    DONE = 10
+
+
+class EpochTarget:
+    def __init__(
+        self,
+        number: int,
+        persisted: Persisted,
+        node_buffers: NodeBuffers,
+        commit_state: CommitState,
+        client_tracker: ClientTracker,
+        batch_tracker: BatchTracker,
+        network_config: pb.NetworkConfig,
+        my_config: pb.InitialParameters,
+        logger=None,
+    ):
+        self.number = number
+        self.persisted = persisted
+        self.node_buffers = node_buffers
+        self.commit_state = commit_state
+        self.client_tracker = client_tracker
+        self.batch_tracker = batch_tracker
+        self.network_config = network_config
+        self.my_config = my_config
+        self.logger = logger
+
+        self.state = TargetState.PREPENDING
+        self.state_ticks = 0
+        self.starting_seq_no = 0
+        # origin node -> EpochChangeCert (digest variants + ACKs)
+        self.changes: dict[int, EpochChangeCert] = {}
+        # origin node -> ParsedEpochChange with a strong cert
+        self.strong_changes: dict[int, ParsedEpochChange] = {}
+        # encoded NewEpochConfig -> (config, voter set)
+        self.echos: dict[bytes, tuple] = {}
+        self.readies: dict[bytes, tuple] = {}
+        self.suspicions: set = set()
+        self.active_epoch: ActiveEpoch | None = None
+        self.my_new_epoch: pb.NewEpoch | None = None  # computed locally
+        self.my_epoch_change: ParsedEpochChange | None = None
+        self.my_leader_choice: list = []
+        self.leader_new_epoch: pb.NewEpoch | None = None  # from the leader
+        self.network_new_epoch: pb.NewEpochConfig | None = None  # via Bracha
+        self.is_leader = number % len(network_config.nodes) == my_config.id
+        self.prestart_buffers = {
+            node: MsgBuffer(
+                f"epoch-{number}-prestart", node_buffers.node_buffer(node)
+            )
+            for node in network_config.nodes
+        }
+
+    # -- three-phase messages ------------------------------------------------
+
+    def step(self, source: int, msg: pb.Msg) -> Actions:
+        if self.state < TargetState.IN_PROGRESS:
+            self.prestart_buffers[source].store(msg)
+            return Actions()
+        if self.state == TargetState.DONE:
+            return Actions()
+        return self.active_epoch.step(source, msg)
+
+    # -- epoch change collection ---------------------------------------------
+
+    def apply_epoch_change_msg(self, source: int, msg: pb.EpochChange) -> Actions:
+        actions = Actions()
+        if source != self.my_config.id:
+            # ACK everyone else's change; ours is already rebroadcast whole.
+            actions.send(
+                self.network_config.nodes,
+                pb.Msg(
+                    type=pb.EpochChangeAck(originator=source, epoch_change=msg)
+                ),
+            )
+        # The originator's own message counts as its ACK.
+        return actions.concat(self.apply_epoch_change_ack(source, source, msg))
+
+    def apply_epoch_change_ack(
+        self, source: int, origin: int, msg: pb.EpochChange
+    ) -> Actions:
+        # ACK certification is over the *digest* of the change; request the
+        # hash from the executor, result returns via apply_epoch_change_digest.
+        from .preimage import epoch_change_hash_data
+
+        return Actions().hash(
+            epoch_change_hash_data(msg),
+            pb.HashResult(
+                digest=b"",
+                type=pb.HashOriginEpochChange(
+                    source=source, origin=origin, epoch_change=msg
+                ),
+            ),
+        )
+
+    def apply_epoch_change_digest(
+        self, origin_info: pb.HashOriginEpochChange, digest: bytes
+    ) -> Actions:
+        origin = origin_info.origin
+        source = origin_info.source
+        cert = self.changes.get(origin)
+        if cert is None:
+            cert = EpochChangeCert(network_config=self.network_config)
+            self.changes[origin] = cert
+        cert.add_msg(source, origin_info.epoch_change, digest)
+
+        if cert.strong_cert is None or origin in self.strong_changes:
+            return Actions()
+        self.strong_changes[origin] = cert.parsed_by_digest[cert.strong_cert]
+        return self.advance_state()
+
+    def check_epoch_quorum(self) -> Actions:
+        if (
+            len(self.strong_changes) < intersection_quorum(self.network_config)
+            or self.my_epoch_change is None
+        ):
+            return Actions()
+
+        self.my_new_epoch = self.construct_new_epoch(self.my_leader_choice)
+        if self.my_new_epoch is None:
+            return Actions()
+
+        self.state_ticks = 0
+        self.state = TargetState.PENDING
+
+        if self.is_leader:
+            return Actions().send(
+                self.network_config.nodes,
+                pb.Msg(type=self.my_new_epoch),
+            )
+        return Actions()
+
+    def construct_new_epoch(self, new_leaders: list) -> pb.NewEpoch | None:
+        filtered = {
+            node: change
+            for node, change in self.strong_changes.items()
+            if change.underlying is not None
+        }
+        if len(filtered) < intersection_quorum(self.network_config):
+            return None
+        new_config = construct_new_epoch_config(
+            self.network_config, new_leaders, filtered
+        )
+        if new_config is None:
+            return None
+
+        remote_changes = [
+            pb.RemoteEpochChange(
+                node_id=node, digest=self.changes[node].strong_cert
+            )
+            for node in self.network_config.nodes
+            if node in self.strong_changes
+        ]
+        return pb.NewEpoch(new_config=new_config, epoch_changes=remote_changes)
+
+    # -- new epoch verification / fetch --------------------------------------
+
+    def apply_new_epoch_msg(self, msg: pb.NewEpoch) -> Actions:
+        self.leader_new_epoch = msg
+        return self.advance_state()
+
+    def verify_new_epoch_state(self) -> Actions:
+        """Recompute the new-epoch config from the changes the leader cites
+        and require byte equality (reference: epoch_target.go:158-195)."""
+        epoch_changes: dict[int, ParsedEpochChange] = {}
+        for remote in self.leader_new_epoch.epoch_changes:
+            if remote.node_id in epoch_changes:
+                return Actions()  # malformed: duplicate origin
+            cert = self.changes.get(remote.node_id)
+            if cert is None:
+                return Actions()  # not enough info yet (or leader lying)
+            parsed = cert.parsed_by_digest.get(remote.digest)
+            if parsed is None or len(parsed.acks) < some_correct_quorum(
+                self.network_config
+            ):
+                return Actions()
+            epoch_changes[remote.node_id] = parsed
+
+        computed = construct_new_epoch_config(
+            self.network_config,
+            self.leader_new_epoch.new_config.config.leaders,
+            epoch_changes,
+        )
+        if computed != self.leader_new_epoch.new_config:
+            return Actions()  # byzantine leader
+
+        self.state = TargetState.FETCHING
+        return self.advance_state()
+
+    def fetch_new_epoch_state(self) -> Actions:
+        """Gather every batch/request the new config's final preprepares
+        reference (reference: epoch_target.go:197-350)."""
+        new_config = self.leader_new_epoch.new_config
+
+        if self.commit_state.transferring:
+            return Actions()  # wait for state transfer first
+
+        if new_config.starting_checkpoint.seq_no > self.commit_state.highest_commit:
+            return self.commit_state.transfer_to(
+                new_config.starting_checkpoint.seq_no,
+                new_config.starting_checkpoint.value,
+            )
+
+        actions = Actions()
+        fetch_pending = False
+
+        for i, digest in enumerate(new_config.final_preprepares):
+            if not digest:
+                continue
+            seq_no = new_config.starting_checkpoint.seq_no + i + 1
+            if seq_no <= self.commit_state.highest_commit:
+                continue
+
+            sources = []
+            for remote in self.leader_new_epoch.epoch_changes:
+                parsed = self.changes[remote.node_id].parsed_by_digest[
+                    remote.digest
+                ]
+                for q_digest in parsed.q_set.get(seq_no, {}).values():
+                    if q_digest == digest:
+                        sources.append(remote.node_id)
+                        break
+            if len(sources) < some_correct_quorum(self.network_config):
+                raise AssertionError(
+                    f"selected digest for seq {seq_no} lacks f+1 qSet sources"
+                )
+
+            batch = self.batch_tracker.get_batch(digest)
+            if batch is None:
+                actions.concat(
+                    self.batch_tracker.fetch_batch(seq_no, digest, sources)
+                )
+                fetch_pending = True
+                continue
+            batch.observed_sequences.add(seq_no)
+
+            for ack in batch.request_acks:
+                cr = None
+                for node in sources:
+                    # Known-correct via f+1 qSets: force past the spam guard.
+                    cr = self.client_tracker.ack(node, ack, force=True)
+                if cr is None or self.my_config.id in cr.agreements:
+                    continue
+                fetch_pending = True
+                actions.concat(cr.fetch())
+
+        if fetch_pending:
+            return actions
+
+        if new_config.starting_checkpoint.seq_no > self.commit_state.low_watermark:
+            # Committed through the checkpoint but it hasn't computed yet.
+            return actions
+
+        self.state = TargetState.ECHOING
+
+        if (
+            new_config.starting_checkpoint.seq_no == self.commit_state.stop_at_seq_no
+            and new_config.final_preprepares
+        ):
+            # Reconfiguration boundary: a correct replica prepared beyond the
+            # stop, so this checkpoint is stable and we must reinitialize
+            # under the new configuration before continuing.  The reference
+            # leaves this as a panic (epoch_target.go:282-300); we surface a
+            # clear error until reconfig-across-epoch-change is supported.
+            raise NotImplementedError(
+                "final preprepares span a reconfiguration boundary"
+            )
+
+        actions.concat(
+            self.persisted.add_n_entry(
+                pb.NEntry(
+                    seq_no=new_config.starting_checkpoint.seq_no + 1,
+                    epoch_config=new_config.config,
+                )
+            )
+        )
+        ci = self.network_config.checkpoint_interval
+        for i, digest in enumerate(new_config.final_preprepares):
+            seq_no = new_config.starting_checkpoint.seq_no + i + 1
+            if not digest:
+                actions.concat(
+                    self.persisted.add_q_entry(pb.QEntry(seq_no=seq_no))
+                )
+            else:
+                batch = self.batch_tracker.get_batch(digest)
+                if batch is None:
+                    raise AssertionError("batch vanished after fetch")
+                actions.concat(
+                    self.persisted.add_q_entry(
+                        pb.QEntry(
+                            seq_no=seq_no,
+                            digest=digest,
+                            requests=batch.request_acks,
+                        )
+                    )
+                )
+            if seq_no % ci == 0 and seq_no < self.commit_state.stop_at_seq_no:
+                actions.concat(
+                    self.persisted.add_n_entry(
+                        pb.NEntry(
+                            seq_no=seq_no + 1, epoch_config=new_config.config
+                        )
+                    )
+                )
+
+        self.starting_seq_no = (
+            new_config.starting_checkpoint.seq_no
+            + len(new_config.final_preprepares)
+            + 1
+        )
+
+        return actions.send(
+            self.network_config.nodes,
+            pb.Msg(type=pb.NewEpochEcho(new_epoch_config=new_config)),
+        )
+
+    # -- Bracha echo / ready -------------------------------------------------
+
+    def _vote(self, table: dict, config: pb.NewEpochConfig, source: int):
+        key = pb.encode(config)
+        entry = table.get(key)
+        if entry is None:
+            entry = (config, set())
+            table[key] = entry
+        entry[1].add(source)
+        return entry[1]
+
+    def apply_new_epoch_echo_msg(
+        self, source: int, msg: pb.NewEpochEcho
+    ) -> Actions:
+        self._vote(self.echos, msg.new_epoch_config, source)
+        return self.advance_state()
+
+    def check_echo_quorum(self) -> Actions:
+        actions = Actions()
+        for config, voters in self.echos.values():
+            if len(voters) < intersection_quorum(self.network_config):
+                continue
+            self.state = TargetState.READYING
+            for i, digest in enumerate(config.final_preprepares):
+                seq_no = config.starting_checkpoint.seq_no + i + 1
+                actions.concat(
+                    self.persisted.add_p_entry(
+                        pb.PEntry(seq_no=seq_no, digest=digest)
+                    )
+                )
+            return actions.send(
+                self.network_config.nodes,
+                pb.Msg(type=pb.NewEpochReady(new_epoch_config=config)),
+            )
+        return actions
+
+    def apply_new_epoch_ready_msg(
+        self, source: int, msg: pb.NewEpochReady
+    ) -> Actions:
+        if self.state > TargetState.READYING:
+            return Actions()  # already accepted the config
+
+        voters = self._vote(self.readies, msg.new_epoch_config, source)
+
+        if len(voters) < some_correct_quorum(self.network_config):
+            return Actions()
+
+        if self.state < TargetState.ECHOING:
+            return self.advance_state()
+
+        if self.state < TargetState.READYING:
+            # f+1 readies let us skip straight to ready (Bracha amplify).
+            self.state = TargetState.READYING
+            return Actions().send(
+                self.network_config.nodes,
+                pb.Msg(type=pb.NewEpochReady(new_epoch_config=msg.new_epoch_config)),
+            )
+
+        return self.advance_state()
+
+    def check_ready_quorum(self) -> None:
+        for config, voters in self.readies.values():
+            if len(voters) < intersection_quorum(self.network_config):
+                continue
+            self.state = TargetState.RESUMING
+            self.network_new_epoch = config
+
+            # Replay our own QEntries from this epoch-change window as
+            # commits (they were selected into the new epoch).
+            current_epoch = False
+
+            def on_q(q_entry):
+                if current_epoch:
+                    self.commit_state.commit(q_entry)
+
+            def on_ec(ec_entry):
+                nonlocal current_epoch
+                if ec_entry.epoch_number < config.config.number:
+                    return
+                if ec_entry.epoch_number > config.config.number:
+                    raise AssertionError(
+                        "epoch-change entries cannot exceed the target epoch"
+                    )
+                current_epoch = True
+
+            self.persisted.iterate({pb.QEntry: on_q, pb.ECEntry: on_ec})
+            return
+
+    def check_epoch_resumed(self) -> None:
+        if self.commit_state.stop_at_seq_no < self.starting_seq_no:
+            return  # waiting for the outstanding checkpoint to commit
+        if self.commit_state.low_watermark + 1 != self.starting_seq_no:
+            return  # waiting for state transfer
+        self.state = TargetState.READY
+
+    # -- the FSM loop --------------------------------------------------------
+
+    def advance_state(self) -> Actions:
+        actions = Actions()
+        while True:
+            old_state = self.state
+            if self.state == TargetState.PREPENDING:
+                actions.concat(self.check_epoch_quorum())
+            elif self.state == TargetState.PENDING:
+                if self.leader_new_epoch is None:
+                    return actions
+                self.state = TargetState.VERIFYING
+            elif self.state == TargetState.VERIFYING:
+                actions.concat(self.verify_new_epoch_state())
+            elif self.state == TargetState.FETCHING:
+                actions.concat(self.fetch_new_epoch_state())
+            elif self.state == TargetState.ECHOING:
+                actions.concat(self.check_echo_quorum())
+            elif self.state == TargetState.READYING:
+                self.check_ready_quorum()
+            elif self.state == TargetState.RESUMING:
+                self.check_epoch_resumed()
+            elif self.state == TargetState.READY:
+                self.active_epoch = ActiveEpoch(
+                    self.network_new_epoch.config,
+                    self.persisted,
+                    self.node_buffers,
+                    self.commit_state,
+                    self.client_tracker,
+                    self.my_config,
+                    self.logger,
+                )
+                actions.concat(self.active_epoch.advance())
+                self.state = TargetState.IN_PROGRESS
+                for node in self.network_config.nodes:
+                    self.prestart_buffers[node].iterate(
+                        lambda *_: Applyable.CURRENT,  # drain everything
+                        lambda src, msg: actions.concat(
+                            self.active_epoch.step(src, msg)
+                        ),
+                    )
+                actions.concat(self.active_epoch.drain_buffers())
+            elif self.state == TargetState.IN_PROGRESS:
+                actions.concat(
+                    self.active_epoch.outstanding_reqs.advance_requests()
+                )
+                actions.concat(self.active_epoch.advance())
+                if self.active_epoch.suspect_bucket_violation:
+                    self.active_epoch.suspect_bucket_violation = False
+                    suspect = pb.Suspect(epoch=self.number)
+                    actions.send(
+                        self.network_config.nodes, pb.Msg(type=suspect)
+                    )
+                    actions.concat(self.persisted.add_suspect(suspect))
+            else:  # ENDING / DONE
+                pass
+            if self.state == old_state:
+                return actions
+
+    def move_low_watermark(self, seq_no: int) -> Actions:
+        if self.state != TargetState.IN_PROGRESS:
+            return Actions()
+        actions, done = self.active_epoch.move_low_watermark(seq_no)
+        if done:
+            self.state = TargetState.DONE
+        return actions
+
+    def apply_suspect_msg(self, source: int) -> None:
+        self.suspicions.add(source)
+        if len(self.suspicions) >= intersection_quorum(self.network_config):
+            self.state = TargetState.DONE
+
+    # -- ticks ---------------------------------------------------------------
+
+    def tick(self) -> Actions:
+        self.state_ticks += 1
+        if self.state == TargetState.PREPENDING:
+            return self._tick_prepending()
+        if self.state <= TargetState.RESUMING:
+            return self._tick_pending()
+        if self.state <= TargetState.IN_PROGRESS:
+            return self.active_epoch.tick()
+        return Actions()
+
+    def _repeat_epoch_change(self) -> Actions:
+        return Actions().send(
+            self.network_config.nodes,
+            pb.Msg(type=self.my_epoch_change.underlying),
+        )
+
+    def _tick_prepending(self) -> Actions:
+        if self.my_new_epoch is None:
+            half = max(self.my_config.new_epoch_timeout_ticks // 2, 1)
+            if self.state_ticks % half == 0:
+                return self._repeat_epoch_change()
+            return Actions()
+        if self.is_leader:
+            return Actions().send(
+                self.network_config.nodes, pb.Msg(type=self.my_new_epoch)
+            )
+        return Actions()
+
+    def _tick_pending(self) -> Actions:
+        timeout = max(self.my_config.new_epoch_timeout_ticks, 2)
+        pending_ticks = self.state_ticks % timeout
+        if self.is_leader:
+            if self.my_new_epoch is not None and pending_ticks % 2 == 0:
+                return Actions().send(
+                    self.network_config.nodes, pb.Msg(type=self.my_new_epoch)
+                )
+        else:
+            if pending_ticks == 0:
+                # In the crash-resume path we never computed a NewEpoch;
+                # suspect our own target number instead (the reference
+                # nil-derefs here, epoch_target.go:417-419).
+                epoch = (
+                    self.my_new_epoch.new_config.config.number
+                    if self.my_new_epoch is not None
+                    else self.number
+                )
+                suspect = pb.Suspect(epoch=epoch)
+                actions = Actions().send(
+                    self.network_config.nodes, pb.Msg(type=suspect)
+                )
+                return actions.concat(self.persisted.add_suspect(suspect))
+            if self.my_epoch_change is not None and pending_ticks % 2 == 0:
+                return self._repeat_epoch_change()
+        return Actions()

@@ -1,0 +1,325 @@
+"""Top-level epoch lifecycle: which epoch we're in, when to change, how to
+resume after a crash.
+
+Rebuild of the reference's epoch tracker (reference: epoch_tracker.go:17-436).
+Holds exactly one current EpochTarget; when it reaches DONE (graceful end,
+suspicion quorum, or the f+1-higher-epoch jump rule) the tracker constructs
+our EpochChange deterministically from the persisted log, persists an
+ECEntry, broadcasts, and starts the next target.  On reinitialize, the log's
+last NEntry/FEntry/ECEntry decide between resuming an active epoch (with a
+precautionary Suspect), converting a graceful end into the next epoch
+change, or continuing an in-flight epoch change.
+"""
+
+from __future__ import annotations
+
+from .. import pb
+from .actions import Actions
+from .batch_tracker import BatchTracker
+from .client_tracker import ClientTracker
+from .commitstate import CommitState
+from .epoch_change import parse_epoch_change
+from .epoch_target import EpochTarget, TargetState
+from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
+from .persisted import Persisted
+from .quorum import some_correct_quorum
+
+_EPOCH_JUMP_TICKS = 10  # ticks behind an f+1-correct higher epoch before jumping
+
+
+def epoch_for_msg(msg: pb.Msg) -> int:
+    inner = msg.type
+    if isinstance(inner, (pb.Preprepare, pb.Prepare, pb.Commit, pb.Suspect)):
+        return inner.epoch
+    if isinstance(inner, pb.EpochChange):
+        return inner.new_epoch
+    if isinstance(inner, pb.EpochChangeAck):
+        return inner.epoch_change.new_epoch
+    if isinstance(inner, pb.NewEpoch):
+        return inner.new_config.config.number
+    if isinstance(inner, (pb.NewEpochEcho, pb.NewEpochReady)):
+        return inner.new_epoch_config.config.number
+    raise AssertionError(f"not an epoch message: {type(inner).__name__}")
+
+
+class EpochTracker:
+    def __init__(
+        self,
+        persisted: Persisted,
+        node_buffers: NodeBuffers,
+        commit_state: CommitState,
+        my_config: pb.InitialParameters,
+        batch_tracker: BatchTracker,
+        client_tracker: ClientTracker,
+        logger=None,
+    ):
+        self.persisted = persisted
+        self.node_buffers = node_buffers
+        self.commit_state = commit_state
+        self.my_config = my_config
+        self.batch_tracker = batch_tracker
+        self.client_tracker = client_tracker
+        self.logger = logger
+
+        self.current_epoch: EpochTarget | None = None
+        self.network_config: pb.NetworkConfig | None = None
+        self.future_msgs: dict[int, MsgBuffer] = {}
+        self.max_epochs: dict[int, int] = {}  # node -> highest epoch claimed
+        self.max_correct_epoch = 0
+        self.ticks_out_of_correct_epoch = 0
+
+    def _new_target(self, number: int) -> EpochTarget:
+        return EpochTarget(
+            number=number,
+            persisted=self.persisted,
+            node_buffers=self.node_buffers,
+            commit_state=self.commit_state,
+            client_tracker=self.client_tracker,
+            batch_tracker=self.batch_tracker,
+            network_config=self.network_config,
+            my_config=self.my_config,
+            logger=self.logger,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reinitialize(self) -> Actions:
+        self.network_config = self.commit_state.active_state.config
+
+        new_future = {}
+        for node in self.network_config.nodes:
+            buffer = self.future_msgs.get(node)
+            if buffer is None:
+                buffer = MsgBuffer(
+                    "future-epochs", self.node_buffers.node_buffer(node)
+                )
+            new_future[node] = buffer
+        self.future_msgs = new_future
+
+        actions = Actions()
+        last_n = last_ec = last_f = None
+        highest_preprepared = 0
+
+        def on_n(entry):
+            nonlocal last_n
+            last_n = entry
+
+        def on_f(entry):
+            nonlocal last_f
+            last_f = entry
+
+        def on_ec(entry):
+            nonlocal last_ec
+            last_ec = entry
+
+        def on_q(entry):
+            nonlocal highest_preprepared
+            highest_preprepared = max(highest_preprepared, entry.seq_no)
+
+        def on_c(entry):
+            # After state transfer we may hold a CEntry beyond any QEntry.
+            nonlocal highest_preprepared
+            highest_preprepared = max(highest_preprepared, entry.seq_no)
+
+        self.persisted.iterate(
+            {
+                pb.NEntry: on_n,
+                pb.FEntry: on_f,
+                pb.ECEntry: on_ec,
+                pb.QEntry: on_q,
+                pb.CEntry: on_c,
+            }
+        )
+
+        if last_n is None and last_f is None:
+            raise AssertionError("no epoch markers in the log")
+
+        if last_n is not None and (
+            last_ec is None or last_ec.epoch_number <= last_n.epoch_config.number
+        ):
+            # Crashed during an active epoch: resume it, but announce our
+            # suspicion so the network can change epochs if it moved on.
+            self.current_epoch = self._new_target(last_n.epoch_config.number)
+            ci = self.network_config.checkpoint_interval
+            starting = highest_preprepared + 1
+            # Round up to the first sequence after a checkpoint boundary so
+            # we never re-consent to sequences we already consented on.
+            # ((s - 1) % ci == 0 — the reference's `s % ci != 1` loop spins
+            # forever for ci == 1, epoch_tracker.go:142.)
+            while (starting - 1) % ci != 0:
+                starting += 1
+            self.current_epoch.starting_seq_no = starting
+            self.current_epoch.state = TargetState.RESUMING
+            # The resume path never receives a NewEpoch; the READY branch
+            # instantiates the active epoch from the resumed config.
+            self.current_epoch.network_new_epoch = pb.NewEpochConfig(
+                config=last_n.epoch_config
+            )
+            suspect = pb.Suspect(epoch=last_n.epoch_config.number)
+            actions.concat(self.persisted.add_suspect(suspect))
+            actions.send(self.network_config.nodes, pb.Msg(type=suspect))
+        else:
+            if last_f is not None and (
+                last_ec is None
+                or last_ec.epoch_number <= last_f.ends_epoch_config.number
+            ):
+                # Graceful end, epoch change not yet begun: begin it.
+                last_ec = pb.ECEntry(
+                    epoch_number=last_f.ends_epoch_config.number + 1
+                )
+                actions.concat(self.persisted.add_ec_entry(last_ec))
+
+            if (
+                self.current_epoch is not None
+                and self.current_epoch.number == last_ec.epoch_number
+            ):
+                # Reinitialized mid-epoch-change: continue it.
+                return actions.concat(self.current_epoch.advance_state())
+
+            epoch_change = self.persisted.construct_epoch_change(
+                last_ec.epoch_number
+            )
+            self.current_epoch = self._new_target(last_ec.epoch_number)
+            self.current_epoch.my_epoch_change = parse_epoch_change(epoch_change)
+            self.current_epoch.my_leader_choice = list(
+                self.network_config.nodes
+            )
+
+        for node in self.network_config.nodes:
+            self.future_msgs[node].iterate(
+                self.filter,
+                lambda src, msg: actions.concat(self.apply_msg(src, msg)),
+            )
+        return actions
+
+    def advance_state(self) -> Actions:
+        if self.current_epoch.state < TargetState.DONE:
+            return self.current_epoch.advance_state()
+
+        if self.commit_state.checkpoint_pending:
+            # Wait for outstanding checkpoints before changing epochs.
+            return Actions()
+
+        new_number = max(self.current_epoch.number + 1, self.max_correct_epoch)
+        epoch_change = self.persisted.construct_epoch_change(new_number)
+
+        self.current_epoch = self._new_target(new_number)
+        self.current_epoch.my_epoch_change = parse_epoch_change(epoch_change)
+        # Leader choice: all nodes (multi-leader; refinement of the set on
+        # failures is future policy — the reference marks its own choices
+        # as placeholders, epoch_tracker.go:199-202,249).
+        self.current_epoch.my_leader_choice = list(self.network_config.nodes)
+        self.ticks_out_of_correct_epoch = 0
+
+        actions = self.persisted.add_ec_entry(
+            pb.ECEntry(epoch_number=new_number)
+        ).send(self.network_config.nodes, pb.Msg(type=epoch_change))
+
+        for node in self.network_config.nodes:
+            self.future_msgs[node].iterate(
+                self.filter,
+                lambda src, msg: actions.concat(self.apply_msg(src, msg)),
+            )
+        return actions
+
+    # -- message routing -----------------------------------------------------
+
+    def filter(self, _source: int, msg: pb.Msg) -> Applyable:
+        number = epoch_for_msg(msg)
+        if number < self.current_epoch.number:
+            return Applyable.PAST
+        if number > self.current_epoch.number:
+            return Applyable.FUTURE
+        return Applyable.CURRENT
+
+    def step(self, source: int, msg: pb.Msg) -> Actions:
+        number = epoch_for_msg(msg)
+        if number < self.current_epoch.number:
+            return Actions()
+        if number > self.current_epoch.number:
+            if self.max_epochs.get(source, 0) < number:
+                self.max_epochs[source] = number
+            self.future_msgs[source].store(msg)
+            return Actions()
+        return self.apply_msg(source, msg)
+
+    def apply_msg(self, source: int, msg: pb.Msg) -> Actions:
+        target = self.current_epoch
+        inner = msg.type
+        if isinstance(inner, (pb.Preprepare, pb.Prepare, pb.Commit)):
+            return target.step(source, msg)
+        if isinstance(inner, pb.Suspect):
+            target.apply_suspect_msg(source)
+            return Actions()
+        if isinstance(inner, pb.EpochChange):
+            return target.apply_epoch_change_msg(source, inner)
+        if isinstance(inner, pb.EpochChangeAck):
+            return target.apply_epoch_change_ack(
+                source, inner.originator, inner.epoch_change
+            )
+        if isinstance(inner, pb.NewEpoch):
+            if inner.new_config.config.number % len(
+                self.network_config.nodes
+            ) != source:
+                return Actions()  # not from the epoch's leader
+            return target.apply_new_epoch_msg(inner)
+        if isinstance(inner, pb.NewEpochEcho):
+            return target.apply_new_epoch_echo_msg(
+                source, inner
+            )
+        if isinstance(inner, pb.NewEpochReady):
+            return target.apply_new_epoch_ready_msg(
+                source, inner
+            )
+        raise AssertionError(f"unexpected epoch msg {type(inner).__name__}")
+
+    # -- results / ticks -----------------------------------------------------
+
+    def apply_batch_hash_result(
+        self, epoch: int, seq_no: int, digest: bytes
+    ) -> Actions:
+        if (
+            epoch != self.current_epoch.number
+            or self.current_epoch.state != TargetState.IN_PROGRESS
+        ):
+            return Actions()
+        return self.current_epoch.active_epoch.apply_batch_hash_result(
+            seq_no, digest
+        )
+
+    def apply_epoch_change_digest(
+        self, origin_info: pb.HashOriginEpochChange, digest: bytes
+    ) -> Actions:
+        target_number = origin_info.epoch_change.new_epoch
+        if target_number < self.current_epoch.number:
+            return Actions()  # stale
+        if target_number > self.current_epoch.number:
+            raise AssertionError(
+                f"epoch change digest for future epoch {target_number} "
+                f"while processing {self.current_epoch.number}"
+            )
+        return self.current_epoch.apply_epoch_change_digest(origin_info, digest)
+
+    def move_low_watermark(self, seq_no: int) -> Actions:
+        return self.current_epoch.move_low_watermark(seq_no)
+
+    def tick(self) -> Actions:
+        # f+1 nodes claiming a higher epoch, observed for long enough,
+        # forces a jump (we are partitioned or slow).  The claimants must be
+        # f+1 *distinct remote* nodes — counting ourselves (as the
+        # reference does, epoch_tracker.go:376-382) would let f byzantine
+        # nodes poison the jump target.
+        for max_epoch in set(self.max_epochs.values()):
+            if max_epoch <= self.max_correct_epoch:
+                continue
+            matches = sum(1 for m in self.max_epochs.values() if m >= max_epoch)
+            if matches < some_correct_quorum(self.network_config):
+                continue
+            self.max_correct_epoch = max_epoch
+
+        if self.max_correct_epoch > self.current_epoch.number:
+            self.ticks_out_of_correct_epoch += 1
+            if self.ticks_out_of_correct_epoch > _EPOCH_JUMP_TICKS:
+                self.current_epoch.state = TargetState.DONE
+
+        return self.current_epoch.tick()

@@ -210,10 +210,12 @@ class Sequence:
 
     def apply_prepare_msg(self, source: int, digest: bytes | None) -> Actions:
         choice = self._node_choice(source)
-        # Duplicate-prepare guard for non-owners only: the owner's "prepare"
-        # is our own synthetic one applied with its preprepare choice already
-        # recorded (reference: sequence.go:260-271).
-        if source != self.owner and choice.state > _NodeState.UNINITIALIZED:
+        # Duplicate-prepare guard for every source.  (The reference exempts
+        # the owner, sequence.go:263-269, which lets the owner's vote be
+        # counted twice at its own node — once from the batch hash result
+        # and once from the self-delivered Preprepare — shaving a node off
+        # the effective prepare quorum there.)
+        if choice.state > _NodeState.UNINITIALIZED:
             return Actions()
         choice.state = _NodeState.PREPREPARED
         choice.digest = digest

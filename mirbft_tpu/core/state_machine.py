@@ -1,0 +1,296 @@
+"""The deterministic protocol state machine: the framework's L1 entry point.
+
+Rebuild of the reference's dispatcher (reference: state_machine.go:95-476).
+The contract (docs/StateMachine.md discipline): a single-threaded, I/O-free,
+clock-free function from StateEvents to Actions.  Every input — inbound
+message, local proposal, tick, hash/checkpoint result, WAL replay — is a
+serializable event, which is what makes every run recordable and replayable.
+
+Lifecycle: Initialize → LoadEntry* → LoadRequest* → CompleteInitialization
+(the runtime's bootstrap WAL synthesizes the initial CEntry+FEntry for fresh
+starts, reference: mirbft.go:162-190).  After every event the dispatcher
+garbage-collects if a checkpoint became stable, then runs the commit-drain +
+epoch-advance fixed point until quiescent.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .. import pb
+from .actions import Actions
+from .batch_tracker import BatchTracker
+from .checkpoints import CheckpointTracker
+from .client_tracker import ClientTracker
+from .commitstate import CommitState
+from .epoch_target import TargetState
+from .epoch_tracker import EpochTracker
+from .msgbuffers import NodeBuffers
+from .persisted import Persisted
+from .preimage import request_hash_data
+
+
+class _SMState(enum.Enum):
+    UNINITIALIZED = 0
+    LOADING = 1
+    INITIALIZED = 2
+
+
+class StateMachine:
+    def __init__(self, logger=None):
+        self.logger = logger
+        self._state = _SMState.UNINITIALIZED
+
+        self.my_config: pb.InitialParameters | None = None
+        self.persisted: Persisted | None = None
+        self.node_buffers: NodeBuffers | None = None
+        self.checkpoint_tracker: CheckpointTracker | None = None
+        self.client_tracker: ClientTracker | None = None
+        self.commit_state: CommitState | None = None
+        self.batch_tracker: BatchTracker | None = None
+        self.epoch_tracker: EpochTracker | None = None
+        self._loaded_reqs: list = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _initialize(self, parameters: pb.InitialParameters) -> None:
+        if self._state is not _SMState.UNINITIALIZED:
+            raise AssertionError("state machine already initialized")
+        self.my_config = parameters
+        self._state = _SMState.LOADING
+
+        self.persisted = Persisted(self.logger)
+        self.node_buffers = NodeBuffers(parameters, self.logger)
+        self.checkpoint_tracker = CheckpointTracker(
+            self.persisted, self.node_buffers, parameters, self.logger
+        )
+        self.client_tracker = ClientTracker(
+            self.persisted, self.node_buffers, parameters, self.logger
+        )
+        self.commit_state = CommitState(
+            self.persisted, self.client_tracker, self.logger
+        )
+        self.batch_tracker = BatchTracker(self.persisted)
+        self.epoch_tracker = EpochTracker(
+            self.persisted,
+            self.node_buffers,
+            self.commit_state,
+            parameters,
+            self.batch_tracker,
+            self.client_tracker,
+            self.logger,
+        )
+
+    def _complete_initialization(self) -> Actions:
+        if self._state is not _SMState.LOADING:
+            raise AssertionError("not loading")
+        self._state = _SMState.INITIALIZED
+        return self._reinitialize()
+
+    def _reinitialize(self) -> Actions:
+        """Rebuild every tracker from the persisted log (start, state
+        transfer, or reconfiguration)."""
+        actions = self._recover_log()
+        self.client_tracker.reinitialize()
+
+        for ack in self._loaded_reqs:
+            # Requests found uncommitted in the request store at startup.
+            self.client_tracker.apply_request_digest(ack, b"")
+        self._loaded_reqs = []
+
+        actions.concat(self.commit_state.reinitialize())
+        self.checkpoint_tracker.reinitialize()
+        self.batch_tracker.reinitialize()
+        return actions.concat(self.epoch_tracker.reinitialize())
+
+    def _recover_log(self) -> Actions:
+        """Resume an interrupted FEntry truncation (reference:
+        state_machine.go:292-310)."""
+        last_c_entry = None
+        actions = Actions()
+
+        def on_c(entry):
+            nonlocal last_c_entry
+            last_c_entry = entry
+
+        def on_f(_entry):
+            if last_c_entry is None:
+                raise AssertionError("FEntry without CEntry: corrupt log")
+            actions.concat(self.persisted.truncate(last_c_entry.seq_no))
+
+        self.persisted.iterate({pb.CEntry: on_c, pb.FEntry: on_f})
+        if last_c_entry is None:
+            raise AssertionError("no checkpoints in the log")
+        return actions
+
+    # -- the event loop ------------------------------------------------------
+
+    def apply_event(self, event: pb.StateEvent) -> Actions:
+        inner = event.type
+        actions = Actions()
+
+        if isinstance(inner, pb.EventInitialize):
+            self._initialize(inner.initial_parms)
+            return Actions()
+        if isinstance(inner, pb.EventLoadEntry):
+            if self._state is not _SMState.LOADING:
+                raise AssertionError("not loading")
+            self.persisted.append_initial_load(inner.index, inner.data)
+            return Actions()
+        if isinstance(inner, pb.EventLoadRequest):
+            self._loaded_reqs.append(inner.request_ack)
+            return Actions()
+        if isinstance(inner, pb.EventCompleteInitialization):
+            actions = self._complete_initialization()
+        elif isinstance(inner, pb.EventActionsReceived):
+            # No-op marker tying action results to the actions that caused
+            # them in recorded logs.
+            return Actions()
+        else:
+            if self._state is not _SMState.INITIALIZED:
+                raise AssertionError(
+                    f"cannot apply {type(inner).__name__} before initialization"
+                )
+            if isinstance(inner, pb.EventTick):
+                actions.concat(self.client_tracker.tick())
+                actions.concat(self.epoch_tracker.tick())
+            elif isinstance(inner, pb.EventStep):
+                actions.concat(self._step(inner.source, inner.msg))
+            elif isinstance(inner, pb.EventPropose):
+                actions.concat(self._propose(inner.request))
+            elif isinstance(inner, pb.EventActionResults):
+                actions.concat(self._process_results(inner))
+            elif isinstance(inner, pb.EventTransfer):
+                if not self.commit_state.transferring:
+                    raise AssertionError(
+                        "transfer event without a requested transfer"
+                    )
+                if inner.c_entry.network_state is None:
+                    # Transfer failed (target GC'd everywhere); retry the
+                    # newest target.  (The reference would trip addCEntry's
+                    # network-state assertion here, state_machine.go:211-217
+                    # with mirbft.go:446-459.)
+                    actions.concat(self.commit_state.retry_transfer())
+                else:
+                    actions.concat(self.persisted.add_c_entry(inner.c_entry))
+                    actions.concat(self._reinitialize())
+            else:
+                raise AssertionError(
+                    f"unknown state event {type(inner).__name__}"
+                )
+
+        # At most one watermark movement is possible per event (a new
+        # checkpoint of our own can only follow the previous checkpoint
+        # result).
+        if self.checkpoint_tracker.garbage_collectable:
+            new_low = self.checkpoint_tracker.garbage_collect()
+            actions.concat(self.persisted.truncate(new_low))
+            self.client_tracker.garbage_collect(new_low)
+            ci = self.checkpoint_tracker.network_config.checkpoint_interval
+            if new_low > ci:
+                # Keep one extra checkpoint interval of batches for epoch
+                # change.
+                self.batch_tracker.truncate(new_low - ci)
+            actions.concat(self.epoch_tracker.move_low_watermark(new_low))
+
+        # Fixed point: drain commits and advance the epoch until quiescent.
+        while True:
+            actions.commits.extend(self.commit_state.drain())
+            loop_actions = self.epoch_tracker.advance_state()
+            if loop_actions.is_empty():
+                break
+            actions.concat(loop_actions)
+
+        return actions
+
+    # -- event handlers ------------------------------------------------------
+
+    def _propose(self, request: pb.Request) -> Actions:
+        return Actions().hash(
+            request_hash_data(request),
+            pb.HashResult(
+                digest=b"",
+                type=pb.HashOriginRequest(
+                    source=self.my_config.id, request=request
+                ),
+            ),
+        )
+
+    def _step(self, source: int, msg: pb.Msg) -> Actions:
+        inner = msg.type
+        if isinstance(inner, (pb.RequestAck, pb.FetchRequest, pb.ForwardRequest)):
+            return self.client_tracker.step(source, msg)
+        if isinstance(inner, pb.Checkpoint):
+            self.checkpoint_tracker.step(source, msg)
+            return Actions()
+        if isinstance(inner, (pb.FetchBatch, pb.ForwardBatch)):
+            return self.batch_tracker.step(source, msg)
+        # Everything else is epoch-scoped.
+        return self.epoch_tracker.step(source, msg)
+
+    def _process_results(self, results: pb.EventActionResults) -> Actions:
+        actions = Actions()
+
+        for checkpoint_result in results.checkpoints:
+            epoch_config = None
+            current = self.epoch_tracker.current_epoch
+            if current is not None and current.active_epoch is not None:
+                epoch_config = current.active_epoch.epoch_config
+            actions.concat(
+                self.commit_state.apply_checkpoint_result(
+                    epoch_config, checkpoint_result
+                )
+            )
+
+        for hash_result in results.digests:
+            origin = hash_result.type
+            digest = hash_result.digest
+            if isinstance(origin, pb.HashOriginBatch):
+                self.batch_tracker.add_batch(
+                    origin.seq_no, digest, origin.request_acks
+                )
+                actions.concat(
+                    self.epoch_tracker.apply_batch_hash_result(
+                        origin.epoch, origin.seq_no, digest
+                    )
+                )
+            elif isinstance(origin, pb.HashOriginRequest):
+                req = origin.request
+                actions.concat(
+                    self.client_tracker.apply_request_digest(
+                        pb.RequestAck(
+                            client_id=req.client_id,
+                            req_no=req.req_no,
+                            digest=digest,
+                        ),
+                        req.data,
+                    )
+                )
+            elif isinstance(origin, pb.HashOriginVerifyRequest):
+                if origin.request_ack.digest != digest:
+                    raise AssertionError(
+                        "forwarded request data does not match its ack digest"
+                    )
+                actions.concat(
+                    self.client_tracker.apply_request_digest(
+                        origin.request_ack, origin.request_data
+                    )
+                )
+            elif isinstance(origin, pb.HashOriginEpochChange):
+                actions.concat(
+                    self.epoch_tracker.apply_epoch_change_digest(origin, digest)
+                )
+            elif isinstance(origin, pb.HashOriginVerifyBatch):
+                self.batch_tracker.apply_verify_batch_hash_result(digest, origin)
+                if (
+                    not self.batch_tracker.has_fetch_in_flight()
+                    and self.epoch_tracker.current_epoch.state
+                    == TargetState.FETCHING
+                ):
+                    actions.concat(
+                        self.epoch_tracker.current_epoch.fetch_new_epoch_state()
+                    )
+            else:
+                raise AssertionError("hash result with no origin type")
+
+        return actions

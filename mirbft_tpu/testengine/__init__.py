@@ -1,0 +1,13 @@
+"""Deterministic multi-node discrete-event simulator.
+
+The rebuild of the reference's highest-leverage test asset (reference:
+testengine/).  Because the protocol core is a pure function StateEvent →
+Actions with no hidden inputs, N "nodes" are just N state-machine values
+advanced by one time-ordered event queue with modeled latencies — epoch
+changes, state transfer, crashes, and adversarial networks are exercised
+in-process, reproducibly, from a seed.  Fixed seed ⇒ fixed event count ⇒
+fixed final app hash, asserted by the determinism gates in
+tests/test_testengine.py.
+"""
+
+from .engine import BasicRecorder, Recorder, RuntimeParameters  # noqa: F401

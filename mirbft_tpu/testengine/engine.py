@@ -1,0 +1,490 @@
+"""The discrete-event simulation engine.
+
+Rebuild of the reference's recorder/player (reference:
+testengine/recorder.go:41-685, testengine/player.go).  One time-ordered
+event queue drives N bare StateMachines; the environment around them — WAL,
+request store, app log, hashing, the network — is modeled with configurable
+latencies.  All randomness comes from a seed; the wall clock is never read.
+
+Consequence scheduling per executed Actions (mirroring the runtime's
+processor contract, docs/Processor.md):
+- persists apply to the node's model WAL immediately (durability modeled as
+  ``persist_latency`` added before dependent sends);
+- sends become Step events at ``+persist_latency+link_latency`` (self
+  deliveries too: the executor loops self-sends back through Step);
+- hashes are computed inline and return as one ActionResults event at
+  ``+ready_latency``;
+- commits apply to a per-node SHA-256 hash chain; checkpoint requests
+  compute the chain value and return with the same ActionResults event;
+- forward-requests read the node's request store and send ForwardRequest
+  messages;
+- state transfer is served from any node's checkpoint store at
+  ``+state_transfer_latency``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import random
+from dataclasses import dataclass, field
+
+from .. import pb
+from ..core import actions as act
+from ..core.preimage import host_digest
+from ..core.state_machine import StateMachine
+
+
+@dataclass
+class RuntimeParameters:
+    """Latency model, in simulated milliseconds (reference defaults:
+    testengine/recorder.go:649-656)."""
+
+    tick_interval: int = 500
+    link_latency: int = 100
+    ready_latency: int = 50
+    process_latency: int = 10
+    persist_latency: int = 10
+    state_transfer_latency: int = 800
+
+
+def standard_initial_network_state(
+    node_count: int, client_ids: list
+) -> pb.NetworkState:
+    """Default protocol constants (reference: mirbft.go:125-154):
+    buckets = nodes, ci = 5*buckets, max epoch length = 10*ci, width 100."""
+    buckets = node_count
+    ci = 5 * buckets
+    return pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(node_count)),
+            f=(node_count - 1) // 3,
+            number_of_buckets=buckets,
+            checkpoint_interval=ci,
+            max_epoch_length=10 * ci,
+        ),
+        clients=[
+            pb.NetworkClient(id=cid, width=100, low_watermark=0)
+            for cid in client_ids
+        ],
+    )
+
+
+@dataclass
+class NodeState:
+    """Environment model for one node."""
+
+    wal: list = field(default_factory=list)  # [(index, pb.Persistent)]
+    wal_truncated_to: int = 0
+    reqstore: dict = field(default_factory=dict)  # digest -> (ack, data)
+    app_chain: bytes = b""  # rolling SHA-256 hash chain of applied batches
+    last_committed: int = 0
+    checkpoints: dict = field(default_factory=dict)  # seq -> (value, state)
+    committed_reqs: list = field(default_factory=list)  # [(client, req_no, seq)]
+    crashed: bool = False
+
+
+@dataclass
+class _ClientState:
+    client_id: int
+    next_req_no: int = 0
+    total_reqs: int = 0
+    # node -> set of this client's req_nos seen committed there
+    committed_by_node: dict = field(default_factory=dict)
+    # req_nos committed anywhere (drives window refill exactly once)
+    committed_anywhere: set = field(default_factory=set)
+
+    def request(self, req_no: int) -> pb.Request:
+        # Deterministic payload, distinct per (client, req_no).
+        data = b"%d:%d" % (self.client_id, req_no)
+        return pb.Request(client_id=self.client_id, req_no=req_no, data=data)
+
+
+class Recorder:
+    """Drives a simulated network to full commitment, recording every event."""
+
+    def __init__(
+        self,
+        node_count: int,
+        client_count: int,
+        reqs_per_client: int,
+        params: RuntimeParameters | None = None,
+        seed: int = 0,
+        batch_size: int = 1,
+        interceptor=None,
+        manglers=(),
+    ):
+        self.params = params or RuntimeParameters()
+        self.rng = random.Random(seed)
+        self.node_count = node_count
+        self.reqs_per_client = reqs_per_client
+        self.batch_size = batch_size
+        self.interceptor = interceptor
+        self.manglers = list(manglers)
+
+        client_ids = [node_count + i for i in range(client_count)]
+        self.initial_state = standard_initial_network_state(
+            node_count, client_ids
+        )
+        self.initial_checkpoint_value = b""
+
+        self.clients = {
+            cid: _ClientState(client_id=cid, total_reqs=reqs_per_client)
+            for cid in client_ids
+        }
+
+        self.event_count = 0
+        self.recorded_events: list = []  # [(time, node, pb.StateEvent)]
+        self._queue: list = []  # heap of (time, seq, node, StateEvent)
+        self._seq = 0
+        self.now = 0
+
+        self.machines: dict[int, StateMachine] = {}
+        self.node_states: dict[int, NodeState] = {}
+        for node in range(node_count):
+            self._start_node(node, at_time=0)
+            self._schedule(self.params.tick_interval, node, _tick_event())
+
+        # Clients submit their initial window of requests to every node.
+        for client in self.clients.values():
+            initial = min(client.total_reqs, 100)
+            for _ in range(initial):
+                self._submit_next_request(client, at_delay=0)
+
+    # -- bootstrap -----------------------------------------------------------
+
+    def _start_node(self, node: int, at_time: int) -> None:
+        """(Re)start a node: Initialize, replay its WAL model (or synthesize
+        the bootstrap log, reference: mirbft.go:162-190), replay uncommitted
+        requests, CompleteInitialization."""
+        self.machines[node] = StateMachine()
+        state = self.node_states.get(node)
+        if state is None:
+            state = NodeState()
+            self.node_states[node] = state
+        state.crashed = False
+
+        my_params = pb.InitialParameters(
+            id=node,
+            batch_size=self.batch_size,
+            heartbeat_ticks=2,
+            suspect_ticks=4,
+            new_epoch_timeout_ticks=8,
+            buffer_size=5 * 1024 * 1024,
+        )
+
+        events = [pb.StateEvent(type=pb.EventInitialize(initial_parms=my_params))]
+        if not state.wal:
+            state.wal = [
+                (
+                    1,
+                    pb.Persistent(
+                        type=pb.CEntry(
+                            seq_no=0,
+                            checkpoint_value=self.initial_checkpoint_value,
+                            network_state=self.initial_state,
+                        )
+                    ),
+                ),
+                (
+                    2,
+                    pb.Persistent(
+                        type=pb.FEntry(
+                            ends_epoch_config=pb.EpochConfig(
+                                number=0,
+                                leaders=self.initial_state.config.nodes,
+                            )
+                        )
+                    ),
+                ),
+            ]
+        for index, entry in state.wal:
+            events.append(
+                pb.StateEvent(type=pb.EventLoadEntry(index=index, data=entry))
+            )
+        for digest, (ack, _data) in sorted(state.reqstore.items()):
+            events.append(
+                pb.StateEvent(type=pb.EventLoadRequest(request_ack=ack))
+            )
+        events.append(pb.StateEvent(type=pb.EventCompleteInitialization()))
+
+        for event in events:
+            self._schedule(at_time - self.now, node, event)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _schedule(self, delay: int, node: int, event: pb.StateEvent) -> None:
+        state = self.node_states.get(node)
+        if state is not None and state.crashed:
+            return  # a down node loses its inbound traffic
+        when = self.now + delay
+        for mangler in self.manglers:
+            verdict = mangler(self, when, node, event)
+            if verdict is None:
+                return  # dropped
+            when, node, event = verdict
+        heapq.heappush(self._queue, (when, self._seq, node, event))
+        self._seq += 1
+
+    def _submit_next_request(self, client: _ClientState, at_delay: int) -> None:
+        if client.next_req_no >= client.total_reqs:
+            return
+        request = client.request(client.next_req_no)
+        client.next_req_no += 1
+        for node in range(self.node_count):
+            self._schedule(
+                at_delay + self.params.link_latency,
+                node,
+                pb.StateEvent(type=pb.EventPropose(request=request)),
+            )
+
+    # -- the loop ------------------------------------------------------------
+
+    def step(self) -> bool:
+        """Process one event; returns False when the queue is empty."""
+        if not self._queue:
+            return False
+        when, _seq, node, event = heapq.heappop(self._queue)
+        self.now = max(self.now, when)
+        machine = self.machines[node]
+        state = self.node_states[node]
+        if state.crashed:
+            return True
+
+        self.event_count += 1
+        if self.interceptor is not None:
+            self.interceptor(node, self.now, event)
+        self.recorded_events.append((self.now, node, event))
+
+        if isinstance(event.type, pb.EventTick):
+            self._schedule(self.params.tick_interval, node, _tick_event())
+        elif (
+            isinstance(event.type, pb.EventTransfer)
+            and event.type.c_entry.network_state is not None
+        ):
+            # The transferred app state is adopted when the transfer event
+            # is *delivered* (not when it was scheduled — the node may have
+            # crashed in between).
+            self._adopt_transferred_state(node, event.type.c_entry)
+
+        actions = machine.apply_event(event)
+        self._execute(node, state, actions)
+        return True
+
+    def _adopt_transferred_state(self, node: int, c_entry: pb.CEntry) -> None:
+        state = self.node_states[node]
+        state.app_chain = c_entry.checkpoint_value
+        state.last_committed = c_entry.seq_no
+        for other in range(self.node_count):
+            stored = self.node_states[other].checkpoints.get(c_entry.seq_no)
+            if stored is None or stored[0] != c_entry.checkpoint_value:
+                continue
+            snapshot = stored[2]
+            for cid, req_nos in snapshot.items():
+                mine = self.clients[cid].committed_by_node.setdefault(
+                    node, set()
+                )
+                mine |= req_nos
+            return
+
+    def _execute(self, node: int, state: NodeState, actions: act.Actions) -> None:
+        """Model the executor: apply durable effects, schedule consequences."""
+        persist_delay = 0
+
+        for write in actions.write_ahead:
+            persist_delay = self.params.persist_latency
+            if write.append is not None:
+                state.wal.append((write.append.index, write.append.data))
+            else:
+                state.wal = [
+                    (i, e) for i, e in state.wal if i >= write.truncate
+                ]
+
+        for fr in actions.store_requests:
+            state.reqstore[fr.request_ack.digest] = (
+                fr.request_ack,
+                fr.request_data,
+            )
+
+        send_delay = persist_delay + self.params.link_latency
+        for send in actions.sends:
+            for target in send.targets:
+                self._schedule(
+                    send_delay,
+                    target,
+                    pb.StateEvent(
+                        type=pb.EventStep(source=node, msg=send.msg)
+                    ),
+                )
+
+        for fwd in actions.forward_requests:
+            stored = state.reqstore.get(fwd.request_ack.digest)
+            if stored is None:
+                continue
+            _ack, data = stored
+            msg = pb.Msg(
+                type=pb.ForwardRequest(
+                    request_ack=fwd.request_ack, request_data=data
+                )
+            )
+            for target in fwd.targets:
+                self._schedule(
+                    send_delay,
+                    target,
+                    pb.StateEvent(type=pb.EventStep(source=node, msg=msg)),
+                )
+
+        results = act.ActionResults()
+        for hr in actions.hashes:
+            results.digests.append(
+                act.HashResult(digest=host_digest(hr.data), request=hr)
+            )
+
+        for commit in actions.commits:
+            if commit.batch is not None:
+                self._apply_batch(node, state, commit.batch)
+            else:
+                cp = commit.checkpoint
+                value = state.app_chain
+                # Snapshot the app state (chain + per-client commits) so a
+                # lagging node can adopt it wholesale via state transfer.
+                snapshot = {
+                    cid: set(c.committed_by_node.get(node, ()))
+                    for cid, c in self.clients.items()
+                }
+                state.checkpoints[cp.seq_no] = (
+                    value,
+                    pb.NetworkState(
+                        config=cp.network_config,
+                        clients=cp.clients_state,
+                    ),
+                    snapshot,
+                )
+                results.checkpoints.append(
+                    act.CheckpointResult(checkpoint=cp, value=value)
+                )
+
+        if results.digests or results.checkpoints:
+            self._schedule(
+                self.params.ready_latency,
+                node,
+                pb.StateEvent(type=act.results_to_event(results)),
+            )
+
+        if actions.state_transfer is not None:
+            self._serve_state_transfer(node, actions.state_transfer)
+
+    def _apply_batch(self, node: int, state: NodeState, batch: pb.QEntry) -> None:
+        state.last_committed = batch.seq_no
+        for ack in batch.requests:
+            h = hashlib.sha256()
+            h.update(state.app_chain)
+            h.update(ack.digest)
+            state.app_chain = h.digest()
+            state.committed_reqs.append((ack.client_id, ack.req_no, batch.seq_no))
+            client = self.clients.get(ack.client_id)
+            if client is not None:
+                client.committed_by_node.setdefault(node, set()).add(ack.req_no)
+                if ack.req_no not in client.committed_anywhere:
+                    # First commit anywhere slides the client's submission
+                    # window (a deterministic stand-in for client waiters).
+                    client.committed_anywhere.add(ack.req_no)
+                    self._submit_next_request(client, at_delay=0)
+
+    def _serve_state_transfer(self, node: int, target: act.StateTarget) -> None:
+        for other in range(self.node_count):
+            stored = self.node_states[other].checkpoints.get(target.seq_no)
+            if stored is None:
+                continue
+            value, network_state, _snapshot = stored
+            if value != target.value:
+                continue
+            # State adoption happens at delivery time (step()); here we only
+            # schedule the transfer's arrival.
+            self._schedule(
+                self.params.state_transfer_latency,
+                node,
+                pb.StateEvent(
+                    type=pb.EventTransfer(
+                        c_entry=pb.CEntry(
+                            seq_no=target.seq_no,
+                            checkpoint_value=value,
+                            network_state=network_state,
+                        )
+                    )
+                ),
+            )
+            return
+        # Nobody has it yet; retry after a delay by re-scheduling the check.
+        self._schedule(
+            self.params.state_transfer_latency,
+            node,
+            pb.StateEvent(
+                type=pb.EventTransfer(
+                    c_entry=pb.CEntry(
+                        seq_no=target.seq_no,
+                        checkpoint_value=target.value,
+                        network_state=None,  # signals failure → retry
+                    )
+                )
+            ),
+        )
+
+    # -- crash / restart (used by manglers) ----------------------------------
+
+    def crash(self, node: int) -> None:
+        self.node_states[node].crashed = True
+        self._queue = [
+            entry for entry in self._queue if entry[2] != node
+        ]
+        heapq.heapify(self._queue)
+
+    def restart(self, node: int) -> None:
+        self._start_node(node, at_time=self.now)
+        self._schedule(self.params.tick_interval, node, _tick_event())
+
+    # -- assertions ----------------------------------------------------------
+
+    def fully_committed(self) -> bool:
+        total = self.reqs_per_client * len(self.clients)
+        if total == 0:
+            return True
+        live_nodes = [
+            n for n in range(self.node_count)
+            if not self.node_states[n].crashed
+        ]
+        for node in live_nodes:
+            seen = sum(
+                len(c.committed_by_node.get(node, ()))
+                for c in self.clients.values()
+            )
+            if seen < total:
+                return False
+        return True
+
+    def drain_clients(self, max_steps: int = 100_000) -> int:
+        """Run until every client's requests commit at every live node;
+        returns the number of events processed (the determinism anchor)."""
+        for _ in range(max_steps):
+            if self.fully_committed():
+                return self.event_count
+            if not self.step():
+                raise AssertionError(
+                    f"event queue drained before full commitment "
+                    f"({self.event_count} events)"
+                )
+        raise AssertionError(
+            f"no full commitment after {max_steps} steps "
+            f"({self.event_count} events)"
+        )
+
+
+def _tick_event() -> pb.StateEvent:
+    return pb.StateEvent(type=pb.EventTick())
+
+
+def BasicRecorder(
+    node_count: int, client_count: int, reqs_per_client: int, **kwargs
+) -> Recorder:
+    """The standard fixture (reference: testengine/recorder.go:637-685)."""
+    return Recorder(node_count, client_count, reqs_per_client, **kwargs)

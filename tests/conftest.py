@@ -22,3 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # across test runs, so paying the compile cost once keeps the suite fast.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/mirbft_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running scale tests")
