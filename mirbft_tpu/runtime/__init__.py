@@ -1,0 +1,16 @@
+"""The runtime around the deterministic core: threads, IO, the TPU executor.
+
+This is the rebuild of the reference's L2-L4 (Node facade + serializer
+goroutine + processors, reference: mirbft.go, serializer.go, processor.go)
+and L3 storage (simplewal/, reqstore/).  The protocol core stays
+single-threaded behind the serializer; executors carry out Actions under
+the safety contract (requests + WAL durable before sends; hashing
+order-free; commits independent), with the TPU processor batching all hash
+work per actions-batch into one kernel launch.
+"""
+
+from .config import Config  # noqa: F401
+from .log import ConsoleLogger, LogLevel  # noqa: F401
+from .node import ClientProposer, Node  # noqa: F401
+from .processor import SerialProcessor, TpuProcessor  # noqa: F401
+from .storage import FileRequestStore, FileWal  # noqa: F401

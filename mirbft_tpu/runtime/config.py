@@ -1,0 +1,38 @@
+"""Local, static node configuration (reference: config.go:13-61).
+
+The consensus-replicated configuration (node set, f, buckets, checkpoint
+interval) lives in pb.NetworkConfig and changes only via reconfiguration;
+this is the per-node operational config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .log import ConsoleLogger, Logger
+
+
+@dataclass
+class Config:
+    id: int
+    logger: Logger = None
+    # Max requests per batch (batches may be cut smaller on heartbeats).
+    batch_size: int = 1
+    # Leader heartbeat period, in ticks.
+    heartbeat_ticks: int = 2
+    # Ticks without commit progress before suspecting the epoch.
+    suspect_ticks: int = 4
+    # Ticks to wait on a new-epoch leader; must be >= 2 (rebroadcast is
+    # computed at half this value).
+    new_epoch_timeout_ticks: int = 8
+    # Per-remote-node byte budget for buffered not-yet-applyable messages.
+    buffer_size: int = 5 * 1024 * 1024
+    # Optional callable(state_event) invoked inside the serializer before
+    # each event application (the tracing hook; see eventlog.Recorder).
+    event_interceptor: object = None
+
+    def __post_init__(self):
+        if self.logger is None:
+            self.logger = ConsoleLogger()
+        if self.new_epoch_timeout_ticks < 2:
+            raise ValueError("new_epoch_timeout_ticks must be >= 2")

@@ -1,0 +1,62 @@
+"""Structural preflight validation of inbound wire messages.
+
+Rebuild of the reference's preProcess (reference: msgfilter.go:18-105),
+run in the *caller's* thread by Node.step before the message enters the
+serializer.  The codec already rejects unset oneofs on decode; this guards
+required nested fields for messages constructed in-process or decoded from
+peers.
+"""
+
+from __future__ import annotations
+
+from .. import pb
+
+
+class MalformedMessage(ValueError):
+    pass
+
+
+def pre_process(msg: pb.Msg) -> None:
+    inner = msg.type
+    if inner is None:
+        raise MalformedMessage("message has no type set")
+    if isinstance(inner, pb.ForwardRequest):
+        if inner.request_ack is None:
+            raise MalformedMessage("ForwardRequest without request_ack")
+    elif isinstance(inner, pb.NewEpoch):
+        cfg = inner.new_config
+        if cfg is None:
+            raise MalformedMessage("NewEpoch without new_config")
+        if cfg.config is None:
+            raise MalformedMessage("NewEpoch without new_config.config")
+        if cfg.starting_checkpoint is None:
+            raise MalformedMessage("NewEpoch without starting_checkpoint")
+    elif isinstance(inner, (pb.NewEpochEcho, pb.NewEpochReady)):
+        cfg = inner.new_epoch_config
+        if cfg is None:
+            raise MalformedMessage(
+                f"{type(inner).__name__} without new_epoch_config"
+            )
+        if cfg.config is None or cfg.starting_checkpoint is None:
+            raise MalformedMessage(
+                f"{type(inner).__name__} config incomplete"
+            )
+    elif isinstance(inner, pb.EpochChangeAck):
+        if inner.epoch_change is None:
+            raise MalformedMessage("EpochChangeAck without epoch_change")
+    elif not isinstance(
+        inner,
+        (
+            pb.Preprepare,
+            pb.Prepare,
+            pb.Commit,
+            pb.Suspect,
+            pb.Checkpoint,
+            pb.RequestAck,
+            pb.FetchRequest,
+            pb.FetchBatch,
+            pb.ForwardBatch,
+            pb.EpochChange,
+        ),
+    ):
+        raise MalformedMessage(f"unknown message type {type(inner).__name__}")

@@ -1,0 +1,379 @@
+"""The Node facade and the serializer thread.
+
+Rebuild of the reference's public API + serializer (reference:
+mirbft.go:44-459, serializer.go:25-257).  All inputs — steps from transport
+threads, proposals from client threads, ticks, action results — funnel
+through one queue into the single protocol thread, which owns the
+StateMachine exclusively.  Accumulated Actions are handed to the consumer
+through a one-slot outbox; each handoff is marked with an ActionsReceived
+event so recorded logs tie results to the actions that caused them.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from .. import pb
+from ..core.state_machine import StateMachine
+from .config import Config
+from .msgfilter import pre_process
+
+
+class NodeStopped(Exception):
+    pass
+
+
+class _BootstrapWal:
+    """Synthesizes the initial CEntry + FEntry for a fresh network
+    (reference: mirbft.go:162-190).  The serializer re-persists these into
+    the real WAL so subsequent starts use restart_node."""
+
+    def __init__(self, initial_network_state, initial_checkpoint_value):
+        self.initial_network_state = initial_network_state
+        self.initial_checkpoint_value = initial_checkpoint_value
+
+    def load_all(self, for_each):
+        for_each(
+            1,
+            pb.Persistent(
+                type=pb.CEntry(
+                    seq_no=0,
+                    checkpoint_value=self.initial_checkpoint_value,
+                    network_state=self.initial_network_state,
+                )
+            ),
+        )
+        for_each(
+            2,
+            pb.Persistent(
+                type=pb.FEntry(
+                    ends_epoch_config=pb.EpochConfig(
+                        number=0,
+                        leaders=self.initial_network_state.config.nodes,
+                    )
+                )
+            ),
+        )
+
+
+class _EmptyReqStore:
+    def uncommitted(self, for_each):
+        pass
+
+
+def standard_initial_network_state(node_count: int, client_ids) -> pb.NetworkState:
+    """Default protocol constants (reference: mirbft.go:125-154)."""
+    buckets = node_count
+    ci = 5 * buckets
+    return pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(node_count)),
+            f=(node_count - 1) // 3,
+            number_of_buckets=buckets,
+            checkpoint_interval=ci,
+            max_epoch_length=10 * ci,
+        ),
+        clients=[
+            pb.NetworkClient(id=cid, width=100, low_watermark=0)
+            for cid in client_ids
+        ],
+    )
+
+
+class _Waiter:
+    """Runtime mirror of the core's ClientWaiter: a real event to block on."""
+
+    def __init__(self, core_waiter):
+        self.core = core_waiter
+        self.expired = threading.Event()
+
+
+class Node:
+    """Thread-safe facade over the serializer thread."""
+
+    def __init__(self, config: Config, wal_storage, req_storage):
+        self.config = config
+        self._inbox: queue.Queue = queue.Queue()
+        self._outbox: queue.Queue = queue.Queue(maxsize=1)
+        self._stopped = threading.Event()
+        self._exit_error: BaseException | None = None
+        self._machine = StateMachine(logger=config.logger)
+        self._waiters: list[_Waiter] = []
+        self._wal_storage = wal_storage
+        self._req_storage = req_storage
+        self._thread = threading.Thread(
+            target=self._run, name=f"mirbft-serializer-{config.id}", daemon=True
+        )
+        self._thread.start()
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def start_new(
+        cls,
+        config: Config,
+        initial_network_state: pb.NetworkState,
+        initial_checkpoint_value: bytes = b"",
+    ) -> "Node":
+        return cls(
+            config,
+            _BootstrapWal(initial_network_state, initial_checkpoint_value),
+            _EmptyReqStore(),
+        )
+
+    @classmethod
+    def restart(cls, config: Config, wal_storage, req_storage) -> "Node":
+        return cls(config, wal_storage, req_storage)
+
+    # -- public API (thread-safe) --------------------------------------------
+
+    def step(self, source: int, msg: pb.Msg) -> None:
+        """Inbound authenticated message from the transport.  Structural
+        validation runs in the caller's thread."""
+        pre_process(msg)
+        self._put(("step", source, msg))
+
+    def propose(self, request: pb.Request) -> None:
+        self._put(("propose", request))
+
+    def tick(self) -> None:
+        self._put(("tick",))
+
+    def add_results(self, results) -> None:
+        """results: core.actions.ActionResults"""
+        self._put(("results", results))
+
+    def state_transfer_complete(self, target, network_state) -> None:
+        self._put(
+            (
+                "transfer",
+                pb.CEntry(
+                    seq_no=target.seq_no,
+                    checkpoint_value=target.value,
+                    network_state=network_state,
+                ),
+            )
+        )
+
+    def state_transfer_failed(self, target) -> None:
+        self._put(
+            (
+                "transfer",
+                pb.CEntry(
+                    seq_no=target.seq_no,
+                    checkpoint_value=target.value,
+                    network_state=None,
+                ),
+            )
+        )
+
+    def ready(self, timeout: float | None = None):
+        """Block for the next batch of Actions; None on timeout/stop."""
+        try:
+            return self._outbox.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def client_proposer(self, client_id: int, blocking: bool = True):
+        waiter = self._request_waiter(client_id)
+        if waiter is None:
+            raise ValueError(f"client {client_id} not registered")
+        return ClientProposer(self, client_id, waiter, blocking)
+
+    def status(self, timeout: float = 5.0):
+        reply: queue.Queue = queue.Queue(maxsize=1)
+        self._put(("status", reply))
+        try:
+            return reply.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._put(("stop",))
+        self._thread.join(timeout=10)
+
+    @property
+    def exit_error(self):
+        return self._exit_error
+
+    def _put(self, item) -> None:
+        if self._stopped.is_set() and item[0] != "stop":
+            raise NodeStopped(str(self._exit_error or "stopped"))
+        self._inbox.put(item)
+
+    def _request_waiter(self, client_id: int):
+        reply: queue.Queue = queue.Queue(maxsize=1)
+        self._put(("waiter", client_id, reply))
+        return reply.get(timeout=5)
+
+    # -- the serializer thread -----------------------------------------------
+
+    def _apply(self, event: pb.StateEvent, actions) -> None:
+        if self.config.event_interceptor is not None:
+            self.config.event_interceptor(event)
+        actions.concat(self._machine.apply_event(event))
+
+    def _run(self) -> None:
+        from ..core.actions import Actions
+
+        actions = Actions()
+        try:
+            self._apply(
+                pb.StateEvent(
+                    type=pb.EventInitialize(
+                        initial_parms=pb.InitialParameters(
+                            id=self.config.id,
+                            batch_size=self.config.batch_size,
+                            heartbeat_ticks=self.config.heartbeat_ticks,
+                            suspect_ticks=self.config.suspect_ticks,
+                            new_epoch_timeout_ticks=self.config.new_epoch_timeout_ticks,
+                            buffer_size=self.config.buffer_size,
+                        )
+                    )
+                ),
+                actions,
+            )
+
+            is_bootstrap = isinstance(self._wal_storage, _BootstrapWal)
+
+            def load_entry(index, entry):
+                if is_bootstrap:
+                    # Re-persist the synthesized log into the real WAL.
+                    actions.persist(index, entry)
+                self._apply(
+                    pb.StateEvent(
+                        type=pb.EventLoadEntry(index=index, data=entry)
+                    ),
+                    actions,
+                )
+
+            self._wal_storage.load_all(load_entry)
+
+            def load_request(ack):
+                # Discard resulting actions: replayed request acks must not
+                # re-store or re-broadcast immediately (the retransmit tick
+                # handles re-acking, reference: serializer.go:170-186).
+                self._apply(
+                    pb.StateEvent(type=pb.EventLoadRequest(request_ack=ack)),
+                    Actions(),
+                )
+
+            self._req_storage.uncommitted(load_request)
+
+            self._apply(
+                pb.StateEvent(type=pb.EventCompleteInitialization()), actions
+            )
+
+            while True:
+                self._flush_outbox(actions)
+                self._notify_waiters()
+                item = self._inbox.get()
+                kind = item[0]
+                if kind == "stop":
+                    return
+                if kind == "step":
+                    self._apply(
+                        pb.StateEvent(
+                            type=pb.EventStep(source=item[1], msg=item[2])
+                        ),
+                        actions,
+                    )
+                elif kind == "propose":
+                    self._apply(
+                        pb.StateEvent(type=pb.EventPropose(request=item[1])),
+                        actions,
+                    )
+                elif kind == "tick":
+                    self._apply(pb.StateEvent(type=pb.EventTick()), actions)
+                elif kind == "results":
+                    from ..core.actions import results_to_event
+
+                    self._apply(
+                        pb.StateEvent(type=results_to_event(item[1])), actions
+                    )
+                elif kind == "transfer":
+                    self._apply(
+                        pb.StateEvent(type=pb.EventTransfer(c_entry=item[1])),
+                        actions,
+                    )
+                elif kind == "waiter":
+                    client = self._machine.client_tracker.client(item[1])
+                    if client is None:
+                        item[2].put(None)
+                    else:
+                        waiter = _Waiter(client.client_waiter)
+                        self._waiters.append(waiter)
+                        item[2].put(waiter)
+                elif kind == "status":
+                    from ..status import state_machine_status
+
+                    item[1].put(state_machine_status(self._machine))
+                else:
+                    raise AssertionError(f"unknown inbox item {kind!r}")
+        except BaseException as err:  # noqa: BLE001 — surfaced via exit_error
+            self._exit_error = err
+            self.config.logger.error(
+                "serializer thread exiting", error=repr(err)
+            )
+        finally:
+            self._stopped.set()
+            for waiter in self._waiters:
+                waiter.expired.set()
+
+    def _flush_outbox(self, actions) -> None:
+        from ..core.actions import Actions
+
+        if actions.is_empty() or self._outbox.full():
+            return
+        handoff = Actions().concat(actions)
+        actions.clear()
+        try:
+            self._outbox.put_nowait(handoff)
+        except queue.Full:
+            actions.concat(handoff)
+            return
+        self._apply(pb.StateEvent(type=pb.EventActionsReceived()), actions)
+
+    def _notify_waiters(self) -> None:
+        live = []
+        for waiter in self._waiters:
+            # The core flips .expired when the window moves; mirror it onto
+            # the runtime event and refresh the registration.
+            if waiter.core.expired:
+                waiter.expired.set()
+            else:
+                live.append(waiter)
+        self._waiters = live
+
+
+class ClientProposer:
+    """Watermark-backpressured proposal API for one client (reference:
+    mirbft.go:53-122)."""
+
+    def __init__(self, node: Node, client_id: int, waiter, blocking: bool):
+        self.node = node
+        self.client_id = client_id
+        self._waiter = waiter
+        self.blocking = blocking
+
+    def propose(self, request: pb.Request, timeout: float | None = 30.0) -> None:
+        while True:
+            low = self._waiter.core.low_watermark
+            high = self._waiter.core.high_watermark
+            if request.req_no < low:
+                raise ValueError(
+                    f"request {request.req_no} below low watermark {low}"
+                )
+            if request.req_no <= high:
+                break
+            if not self.blocking:
+                raise ValueError("request above watermarks (non-blocking)")
+            if not self._waiter.expired.wait(timeout=timeout):
+                raise TimeoutError("window did not move in time")
+            refreshed = self.node._request_waiter(self.client_id)
+            if refreshed is None:
+                raise NodeStopped("client no longer registered")
+            self._waiter = refreshed
+        self.node.propose(request)

@@ -1,0 +1,165 @@
+"""Action executors: where the consumer's work — and the TPU — happens.
+
+Rebuild of the reference's processors (reference: processor.go:56-470).
+The ordering contract is safety-critical (docs/Processor.md:24-28):
+
+  1. store requests, sync the request store
+  2. write + sync the WAL                        ← durability barrier
+  3. network sends (self-sends loop back through Node.step)
+  4. forward requests (read data from the store)
+  5. hashing                                     ← order-free, the TPU path
+  6. commits: apply batches to the Log; checkpoints snap it
+
+The TpuProcessor coalesces every hash request in the actions batch into one
+padded tensor and runs a single batched SHA-256 kernel launch (ops.sha256),
+overlapping the device round trip with the persist+send phases — the
+reference's work-pool slack (hashing is order-free) realized as accelerator
+batching instead of goroutines.
+"""
+
+from __future__ import annotations
+
+from .. import pb
+from ..core import actions as act
+from ..core.preimage import host_digest
+
+
+class Link:
+    """The entire transport contract (reference: processor.go:23-25):
+    fire-and-forget, unreliable by assumption, caller authenticates."""
+
+    def send(self, dest: int, msg: pb.Msg) -> None:
+        raise NotImplementedError
+
+
+class Log:
+    """The application: applies totally-ordered batches and snapshots."""
+
+    def apply(self, q_entry: pb.QEntry) -> None:
+        raise NotImplementedError
+
+    def snap(self, network_config, clients_state) -> bytes:
+        raise NotImplementedError
+
+
+class SerialProcessor:
+    def __init__(self, node, link: Link, app_log: Log, wal, request_store):
+        self.node = node
+        self.link = link
+        self.app_log = app_log
+        self.wal = wal
+        self.request_store = request_store
+
+    # -- phases --------------------------------------------------------------
+
+    def _persist(self, actions: act.Actions) -> None:
+        for fr in actions.store_requests:
+            self.request_store.store(fr.request_ack, fr.request_data)
+        self.request_store.sync()
+
+        for write in actions.write_ahead:
+            if write.truncate is not None:
+                self.wal.truncate(write.truncate)
+            else:
+                self.wal.write(write.append.index, write.append.data)
+        self.wal.sync()
+
+    def _transmit(self, actions: act.Actions) -> None:
+        my_id = self.node.config.id
+        for send in actions.sends:
+            for replica in send.targets:
+                if replica == my_id:
+                    self.node.step(replica, send.msg)
+                else:
+                    self.link.send(replica, send.msg)
+        for fwd in actions.forward_requests:
+            data = self.request_store.get(fwd.request_ack)
+            if data is None:
+                continue  # already committed + pruned; nothing to forward
+            msg = pb.Msg(
+                type=pb.ForwardRequest(
+                    request_ack=fwd.request_ack, request_data=data
+                )
+            )
+            for replica in fwd.targets:
+                if replica == my_id:
+                    self.node.step(replica, msg)
+                else:
+                    self.link.send(replica, msg)
+
+    def _hash(self, actions: act.Actions) -> list:
+        return [
+            act.HashResult(digest=host_digest(hr.data), request=hr)
+            for hr in actions.hashes
+        ]
+
+    def _commit(self, actions: act.Actions) -> list:
+        checkpoints = []
+        for commit in actions.commits:
+            if commit.batch is not None:
+                self.app_log.apply(commit.batch)
+                for ack in commit.batch.requests:
+                    self.request_store.commit(ack)
+            else:
+                value = self.app_log.snap(
+                    commit.checkpoint.network_config,
+                    commit.checkpoint.clients_state,
+                )
+                checkpoints.append(
+                    act.CheckpointResult(
+                        checkpoint=commit.checkpoint, value=value
+                    )
+                )
+        return checkpoints
+
+    def process(self, actions: act.Actions) -> act.ActionResults:
+        self._persist(actions)
+        self._transmit(actions)
+        digests = self._hash(actions)
+        checkpoints = self._commit(actions)
+        return act.ActionResults(digests=digests, checkpoints=checkpoints)
+
+
+class TpuProcessor(SerialProcessor):
+    """SerialProcessor with the hash phase dispatched to the accelerator.
+
+    All hash requests in the batch launch as one bucketed kernel call; the
+    dispatch is issued *before* the persist/send phases so the device works
+    while the host fsyncs, and the results are collected afterwards — the
+    persist→send barrier is untouched because hashing feeds nothing but
+    AddResults."""
+
+    # Below this many hash requests the device round trip isn't worth it.
+    min_batch_for_device = 64
+
+    def process(self, actions: act.Actions) -> act.ActionResults:
+        pending = None
+        if len(actions.hashes) >= self.min_batch_for_device:
+            pending = self._dispatch_device(actions.hashes)
+
+        self._persist(actions)
+        self._transmit(actions)
+
+        if pending is not None:
+            digests = self._collect_device(actions.hashes, pending)
+        else:
+            digests = self._hash(actions)
+
+        checkpoints = self._commit(actions)
+        return act.ActionResults(digests=digests, checkpoints=checkpoints)
+
+    def _dispatch_device(self, hashes: list):
+        from ..ops.batching import pack_preimages
+        from ..ops.sha256 import sha256_digest_words
+
+        packed = pack_preimages([b"".join(hr.data) for hr in hashes])
+        return sha256_digest_words(packed.blocks, packed.n_blocks)
+
+    def _collect_device(self, hashes: list, words) -> list:
+        import numpy as np
+
+        raw = np.asarray(words).astype(">u4").tobytes()
+        return [
+            act.HashResult(digest=raw[32 * i : 32 * i + 32], request=hr)
+            for i, hr in enumerate(hashes)
+        ]

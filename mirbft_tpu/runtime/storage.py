@@ -1,0 +1,241 @@
+"""Durable storage: the write-ahead log and the request store.
+
+Rebuild of the reference's storage layer (reference:
+simplewal/simplewal.go:22-109 over tidwall/wal; reqstore/reqstore.go:24-100
+over BadgerDB) as dependency-free file formats:
+
+- FileWal: an append-only segmented log of (index, Persistent) records.
+  Appends go to the active segment; ``truncate(index)`` (truncate-front)
+  deletes whole segments below the index and tombstones the rest via a
+  head-index marker; ``sync`` fsyncs.  Records are length-prefixed canonical
+  encodings with a CRC so torn tails are detected and discarded on load.
+- FileRequestStore: an append-only intent log of store/commit records with
+  an in-memory index; ``uncommitted`` replays stores minus commits at
+  startup; compaction rewrites the live set on open.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+from .. import pb, wire
+
+_REC_HEADER = struct.Struct("<IQI")  # payload_len, index, crc32(payload)
+_SEGMENT_TARGET = 4 * 1024 * 1024
+
+
+class CorruptWal(Exception):
+    pass
+
+
+class FileWal:
+    """Write(index, entry) / truncate(index) / sync + load_all replay.
+
+    Layout: <dir>/segments/<first_index>.wal + <dir>/head containing the
+    logical head index (entries below it are dead even if still on disk).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.seg_dir = os.path.join(path, "segments")
+        os.makedirs(self.seg_dir, exist_ok=True)
+        self._head_path = os.path.join(path, "head")
+        self._head_index = self._read_head()
+        self._entries = self._load_from_disk()  # [(index, entry)]
+        self._active = None  # open file handle for appends
+        self._active_size = 0
+        self._needs_sync = False
+
+    # -- load ----------------------------------------------------------------
+
+    def _read_head(self) -> int:
+        try:
+            with open(self._head_path, "rb") as f:
+                return int(f.read().decode() or "0")
+        except FileNotFoundError:
+            return 0
+
+    def _segments(self):
+        names = []
+        for name in os.listdir(self.seg_dir):
+            if name.endswith(".wal"):
+                names.append(int(name[:-4]))
+        return sorted(names)
+
+    def _load_from_disk(self):
+        entries = []
+        for first in self._segments():
+            path = os.path.join(self.seg_dir, f"{first}.wal")
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos < len(data):
+                if pos + _REC_HEADER.size > len(data):
+                    break  # torn tail
+                length, index, crc = _REC_HEADER.unpack_from(data, pos)
+                start = pos + _REC_HEADER.size
+                payload = data[start : start + length]
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break  # torn/corrupt tail: discard the rest
+                entries.append((index, pb.decode(pb.Persistent, payload)))
+                pos = start + length
+        return [(i, e) for i, e in entries if i >= self._head_index]
+
+    def load_all(self, for_each) -> None:
+        """Invoke for_each(index, pb.Persistent) over the live log."""
+        for index, entry in self._entries:
+            for_each(index, entry)
+
+    # -- runtime interface ---------------------------------------------------
+
+    def _open_active(self, first_index: int):
+        path = os.path.join(self.seg_dir, f"{first_index}.wal")
+        self._active = open(path, "ab")
+        self._active_size = self._active.tell()
+
+    def write(self, index: int, entry: pb.Persistent) -> None:
+        if self._entries and index != self._entries[-1][0] + 1:
+            raise CorruptWal(
+                f"non-contiguous append: {index} after {self._entries[-1][0]}"
+            )
+        payload = pb.encode(entry)
+        if self._active is None or self._active_size >= _SEGMENT_TARGET:
+            if self._active is not None:
+                self._active.flush()
+                os.fsync(self._active.fileno())
+                self._active.close()
+            self._open_active(index)
+        record = _REC_HEADER.pack(len(payload), index, zlib.crc32(payload))
+        self._active.write(record + payload)
+        self._active_size += len(record) + len(payload)
+        self._entries.append((index, entry))
+        self._needs_sync = True
+
+    def truncate(self, index: int) -> None:
+        """Truncate-front: drop every entry with index < the given index."""
+        self._head_index = index
+        with open(self._head_path + ".tmp", "wb") as f:
+            f.write(str(index).encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(self._head_path + ".tmp", self._head_path)
+        self._entries = [(i, e) for i, e in self._entries if i >= index]
+        # Remove whole segments that ended below the head.
+        segments = self._segments()
+        for seg_first, seg_next in zip(segments, segments[1:]):
+            if seg_next <= index:
+                seg_path = os.path.join(self.seg_dir, f"{seg_first}.wal")
+                if self._active is not None and self._active.name == seg_path:
+                    continue
+                os.unlink(seg_path)
+
+    def sync(self) -> None:
+        if self._active is not None and self._needs_sync:
+            self._active.flush()
+            os.fsync(self._active.fileno())
+            self._needs_sync = False
+
+    def close(self) -> None:
+        if self._active is not None:
+            self.sync()
+            self._active.close()
+            self._active = None
+
+
+_REQ_HEADER = struct.Struct("<BII")  # op, ack_len, data_len
+_OP_STORE = 1
+_OP_COMMIT = 2
+
+
+class FileRequestStore:
+    """store/get/commit/sync + uncommitted replay.
+
+    An intent log: STORE records carry (ack, data); COMMIT records carry the
+    ack only.  The live (uncommitted) set is the stores minus the commits;
+    compaction rewrites just the live set at open.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._log_path = os.path.join(path, "requests.log")
+        self._index: dict[bytes, tuple] = {}  # key -> (ack, data)
+        self._replay()
+        self._compact()
+        self._file = open(self._log_path, "ab")
+
+    @staticmethod
+    def _key(ack: pb.RequestAck) -> bytes:
+        return (
+            wire.encode_varint(ack.client_id)
+            + wire.encode_varint(ack.req_no)
+            + ack.digest
+        )
+
+    def _replay(self) -> None:
+        try:
+            with open(self._log_path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return
+        pos = 0
+        while pos + _REQ_HEADER.size <= len(data):
+            op, ack_len, data_len = _REQ_HEADER.unpack_from(data, pos)
+            pos += _REQ_HEADER.size
+            if pos + ack_len + data_len > len(data):
+                break  # torn tail
+            try:
+                ack = pb.decode(pb.RequestAck, data[pos : pos + ack_len])
+            except ValueError:
+                break
+            payload = data[pos + ack_len : pos + ack_len + data_len]
+            pos += ack_len + data_len
+            if op == _OP_STORE:
+                self._index[self._key(ack)] = (ack, payload)
+            elif op == _OP_COMMIT:
+                self._index.pop(self._key(ack), None)
+
+    def _compact(self) -> None:
+        tmp = self._log_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for ack, data in self._index.values():
+                self._write_record(f, _OP_STORE, ack, data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._log_path)
+
+    @staticmethod
+    def _write_record(f, op: int, ack: pb.RequestAck, data: bytes) -> None:
+        ack_bytes = pb.encode(ack)
+        f.write(_REQ_HEADER.pack(op, len(ack_bytes), len(data)))
+        f.write(ack_bytes)
+        f.write(data)
+
+    # -- runtime interface ---------------------------------------------------
+
+    def store(self, ack: pb.RequestAck, data: bytes) -> None:
+        self._write_record(self._file, _OP_STORE, ack, data or b"")
+        self._index[self._key(ack)] = (ack, data or b"")
+
+    def get(self, ack: pb.RequestAck) -> bytes | None:
+        entry = self._index.get(self._key(ack))
+        return entry[1] if entry is not None else None
+
+    def commit(self, ack: pb.RequestAck) -> None:
+        self._write_record(self._file, _OP_COMMIT, ack, b"")
+        self._index.pop(self._key(ack), None)
+
+    def sync(self) -> None:
+        self._file.flush()
+        os.fsync(self._file.fileno())
+
+    def uncommitted(self, for_each) -> None:
+        """Invoke for_each(ack) for every stored-but-uncommitted request, in
+        deterministic key order."""
+        for key in sorted(self._index):
+            for_each(self._index[key][0])
+
+    def close(self) -> None:
+        self._file.close()

@@ -1,0 +1,267 @@
+"""Read-only status snapshots and the ASCII dashboard.
+
+Rebuild of the reference's status package (reference: status/status.go:
+73-296): a JSON-able deep snapshot of every tracker, taken on demand via
+the serializer, plus a pretty renderer showing buckets, sequences,
+checkpoints, and client windows.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+from .core.epoch_target import TargetState
+from .core.sequence import SeqState
+
+
+@dataclass
+class BucketStatus:
+    id: int
+    leader: bool
+    sequences: list = field(default_factory=list)  # [str: sequence states]
+
+
+@dataclass
+class CheckpointStatus:
+    seq_no: int
+    max_agreements: int
+    net_quorum: bool
+    local_decision: bool
+    stable: bool
+
+
+@dataclass
+class ClientStatus:
+    client_id: int
+    low_watermark: int
+    high_watermark: int
+    next_ready_mark: int
+    # per req_no in window: "" (empty), A (acked), W (weak), S (strong),
+    # R (ready/local), C (committed)
+    allocated: list = field(default_factory=list)
+
+
+@dataclass
+class EpochChangeStatus:
+    source: int
+    msgs: list = field(default_factory=list)  # [(digest_hex, [ackers])]
+
+
+@dataclass
+class EpochTargetStatus:
+    number: int
+    state: str
+    epoch_changes: list = field(default_factory=list)
+    echos: list = field(default_factory=list)
+    readies: list = field(default_factory=list)
+    suspicions: list = field(default_factory=list)
+
+
+@dataclass
+class StateMachineStatus:
+    node_id: int
+    low_watermark: int
+    high_watermark: int
+    epoch_tracker: EpochTargetStatus | None
+    client_windows: list = field(default_factory=list)
+    buckets: list = field(default_factory=list)
+    checkpoints: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, default=str)
+
+    def pretty(self) -> str:
+        return pretty(self)
+
+
+_SEQ_CHARS = {
+    SeqState.UNINITIALIZED: ".",
+    SeqState.ALLOCATED: "a",
+    SeqState.PENDING_REQUESTS: "q",
+    SeqState.READY: "r",
+    SeqState.PREPREPARED: "Q",
+    SeqState.PREPARED: "P",
+    SeqState.COMMITTED: "C",
+}
+
+
+def _client_status(client) -> ClientStatus:
+    allocated = []
+    for crn in client.req_nos():
+        if crn.committed is not None:
+            allocated.append("C")
+        elif any(d in crn.my_requests for d in crn.strong_requests):
+            allocated.append("R")
+        elif crn.strong_requests:
+            allocated.append("S")
+        elif crn.weak_requests:
+            allocated.append("W")
+        elif crn.requests:
+            allocated.append("A")
+        else:
+            allocated.append("")
+    while allocated and allocated[-1] == "":
+        allocated.pop()
+    return ClientStatus(
+        client_id=client.client_state.id,
+        low_watermark=client.low_watermark,
+        high_watermark=client.high_watermark,
+        next_ready_mark=client.next_ready_mark,
+        allocated=allocated,
+    )
+
+
+def state_machine_status(machine) -> StateMachineStatus:
+    """Snapshot a core.state_machine.StateMachine.  Must be called from the
+    thread that owns the machine (the serializer does this)."""
+    if machine.my_config is None or machine.epoch_tracker is None or \
+            machine.epoch_tracker.current_epoch is None:
+        return StateMachineStatus(
+            node_id=machine.my_config.id if machine.my_config else -1,
+            low_watermark=0,
+            high_watermark=0,
+            epoch_tracker=None,
+        )
+
+    target = machine.epoch_tracker.current_epoch
+
+    epoch_changes = []
+    for origin in sorted(target.changes):
+        cert = target.changes[origin]
+        msgs = [
+            (digest.hex()[:16], sorted(parsed.acks))
+            for digest, parsed in sorted(cert.parsed_by_digest.items())
+        ]
+        epoch_changes.append(EpochChangeStatus(source=origin, msgs=msgs))
+
+    def voters(table):
+        out = []
+        for _cfg, votes in table.values():
+            out.extend(votes)
+        return sorted(set(out))
+
+    tracker_status = EpochTargetStatus(
+        number=target.number,
+        state=TargetState(target.state).name,
+        epoch_changes=epoch_changes,
+        echos=voters(target.echos),
+        readies=voters(target.readies),
+        suspicions=sorted(target.suspicions),
+    )
+
+    low = high = 0
+    buckets = []
+    active = target.active_epoch
+    if active is not None and active.sequences:
+        low = active.low_watermark()
+        high = active.high_watermark()
+        per_bucket: dict[int, list] = {b: [] for b in active.buckets}
+        for seq_no in range(low, high + 1):
+            seq = active.sequence(seq_no)
+            per_bucket[active.seq_bucket(seq_no)].append(
+                _SEQ_CHARS[seq.state]
+            )
+        buckets = [
+            BucketStatus(
+                id=b,
+                leader=active.buckets[b] == machine.my_config.id,
+                sequences=per_bucket[b],
+            )
+            for b in sorted(per_bucket)
+        ]
+
+    checkpoints = [
+        CheckpointStatus(
+            seq_no=cp.seq_no,
+            max_agreements=max(
+                (len(nodes) for nodes in cp.votes.values()), default=0
+            ),
+            net_quorum=cp.committed_value is not None,
+            local_decision=cp.my_value is not None,
+            stable=cp.stable,
+        )
+        for cp in sorted(
+            machine.checkpoint_tracker.checkpoint_map.values(),
+            key=lambda c: c.seq_no,
+        )
+    ]
+
+    clients = [
+        _client_status(machine.client_tracker.clients[cs.id])
+        for cs in machine.client_tracker.client_states
+    ]
+
+    return StateMachineStatus(
+        node_id=machine.my_config.id,
+        low_watermark=low,
+        high_watermark=high,
+        epoch_tracker=tracker_status,
+        client_windows=clients,
+        buckets=buckets,
+        checkpoints=checkpoints,
+    )
+
+
+def pretty(status: StateMachineStatus) -> str:
+    """ASCII dashboard (reference: status/status.go:141-296)."""
+    lines = [
+        f"===========================================",
+        f"NodeID={status.node_id}, "
+        f"LowWatermark={status.low_watermark}, "
+        f"HighWatermark={status.high_watermark}, "
+        f"Epoch={status.epoch_tracker.number if status.epoch_tracker else '?'} "
+        f"({status.epoch_tracker.state if status.epoch_tracker else '?'})",
+        f"===========================================",
+        "",
+    ]
+    if status.buckets:
+        lines.append("=== Buckets ===")
+        lines.append("  (.=unalloc a=alloc q=pending r=ready "
+                     "Q=preprepared P=prepared C=committed)")
+        for bucket in status.buckets:
+            marker = "*" if bucket.leader else " "
+            lines.append(
+                f"  {marker}bucket {bucket.id}: {''.join(bucket.sequences)}"
+            )
+        lines.append("")
+    if status.checkpoints:
+        lines.append("=== Checkpoints ===")
+        for cp in status.checkpoints:
+            flags = "".join(
+                c
+                for c, on in (
+                    ("N", cp.net_quorum),
+                    ("L", cp.local_decision),
+                    ("S", cp.stable),
+                )
+                if on
+            )
+            lines.append(
+                f"  seq {cp.seq_no}: agreements={cp.max_agreements} [{flags}]"
+            )
+        lines.append("")
+    if status.client_windows:
+        lines.append("=== Clients ===")
+        lines.append("  (A=acked W=weak S=strong R=ready C=committed)")
+        for client in status.client_windows:
+            window = "".join(c or "_" for c in client.allocated)
+            lines.append(
+                f"  client {client.client_id} "
+                f"[{client.low_watermark}..{client.high_watermark}] "
+                f"ready@{client.next_ready_mark}: {window}"
+            )
+        lines.append("")
+    if status.epoch_tracker:
+        et = status.epoch_tracker
+        if et.epoch_changes or et.echos or et.readies or et.suspicions:
+            lines.append("=== Epoch Transition ===")
+            for ec in et.epoch_changes:
+                lines.append(f"  change from {ec.source}: {ec.msgs}")
+            if et.echos:
+                lines.append(f"  echos: {et.echos}")
+            if et.readies:
+                lines.append(f"  readies: {et.readies}")
+            if et.suspicions:
+                lines.append(f"  suspicions: {et.suspicions}")
+    return "\n".join(lines)

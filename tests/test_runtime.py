@@ -1,0 +1,309 @@
+"""Stress-tier gate: real threads, real files, real (in-process) transport
+(SURVEY §4 tier 3; reference: stress_test.go).  Asserts each request commits
+exactly once per node, and that a node restarted from its WAL resumes."""
+
+import hashlib
+import queue
+import threading
+import time
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core.preimage import host_digest, request_hash_data
+from mirbft_tpu.runtime import (
+    Config,
+    FileRequestStore,
+    FileWal,
+    Node,
+    SerialProcessor,
+)
+from mirbft_tpu.runtime.node import standard_initial_network_state
+from mirbft_tpu.runtime.processor import Link, Log
+
+
+class ThreadTransport:
+    """Channel-matrix fake transport (reference: stress_test.go:68-151)."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.lock = threading.Lock()
+
+    def register(self, node_id, node):
+        with self.lock:
+            self.nodes[node_id] = node
+
+    def unregister(self, node_id):
+        with self.lock:
+            self.nodes.pop(node_id, None)
+
+    def link(self, source: int) -> Link:
+        transport = self
+
+        class _Link(Link):
+            def send(self, dest, msg):
+                with transport.lock:
+                    node = transport.nodes.get(dest)
+                if node is None:
+                    return  # dropped: dest down
+                try:
+                    node.step(source, msg)
+                except Exception:
+                    pass  # unreliable link semantics
+
+        return _Link()
+
+
+class HashChainLog(Log):
+    def __init__(self):
+        self.chain = b""
+        self.commits = []  # [(client_id, req_no, seq_no)]
+        self.commit_events = queue.Queue()
+
+    def apply(self, q_entry):
+        for ack in q_entry.requests:
+            h = hashlib.sha256()
+            h.update(self.chain)
+            h.update(ack.digest)
+            self.chain = h.digest()
+            self.commits.append((ack.client_id, ack.req_no, q_entry.seq_no))
+            self.commit_events.put((ack.client_id, ack.req_no))
+
+    def snap(self, network_config, clients_state):
+        return self.chain
+
+
+class Replica:
+    """One node: serializer + consumer loop thread + storage."""
+
+    def __init__(self, node_id, transport, tmp_path, initial_state=None,
+                 tick_seconds=0.05):
+        self.node_id = node_id
+        self.transport = transport
+        self.dir = tmp_path / f"node{node_id}"
+        self.tick_seconds = tick_seconds
+        self.app_log = HashChainLog()
+        self.wal = FileWal(str(self.dir / "wal"))
+        self.reqstore = FileRequestStore(str(self.dir / "reqs"))
+        config = Config(id=node_id)
+        if initial_state is not None:
+            self.node = Node.start_new(config, initial_state)
+        else:
+            self.node = Node.restart(config, self.wal, self.reqstore)
+        self.processor = SerialProcessor(
+            self.node, transport.link(node_id), self.app_log, self.wal,
+            self.reqstore,
+        )
+        transport.register(node_id, self.node)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._consume, name=f"consumer-{node_id}", daemon=True
+        )
+        self._thread.start()
+
+    def _consume(self):
+        last_tick = time.monotonic()
+        while not self._stop.is_set():
+            actions = self.node.ready(timeout=0.01)
+            if actions is not None:
+                results = self.processor.process(actions)
+                if results.digests or results.checkpoints:
+                    try:
+                        self.node.add_results(results)
+                    except Exception:
+                        return
+            now = time.monotonic()
+            if now - last_tick >= self.tick_seconds:
+                last_tick = now
+                try:
+                    self.node.tick()
+                except Exception:
+                    return
+                # Serve any state-transfer requests out of band.
+                # (Transfer actions are handled via actions.state_transfer.)
+            if actions is not None and actions.state_transfer is not None:
+                self._serve_transfer(actions.state_transfer)
+
+    def _serve_transfer(self, target):
+        # Out-of-band state fetch: ask the other replicas' app logs.
+        for node in self.transport.nodes.values():
+            if node is self.node:
+                continue
+            # In this harness all state is derivable; accept the target.
+        # Reference consumers fetch app state out of band; here the app
+        # chain is reconstructed from peers lazily via the protocol.
+        self.node.state_transfer_failed(target)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.transport.unregister(self.node_id)
+        self.node.stop()
+        self.wal.close()
+        self.reqstore.close()
+
+
+def await_commits(replicas, expected, timeout=60.0):
+    """Wait until each replica has committed at least `expected` (a replica
+    restarted from a WAL may additionally replay commits made after its
+    last stable checkpoint — that is correct protocol behavior)."""
+    deadline = time.monotonic() + timeout
+    for replica in replicas:
+        got = set()
+        while not expected <= got:
+            remaining = deadline - time.monotonic()
+            assert remaining > 0, (
+                f"node {replica.node_id} timed out with "
+                f"{len(got & expected)}/{len(expected)} commits; "
+                f"exit={replica.node.exit_error!r}"
+            )
+            try:
+                got.add(replica.app_log.commit_events.get(timeout=min(remaining, 1)))
+            except queue.Empty:
+                continue
+
+
+def make_requests(client_id, count):
+    out = []
+    for req_no in range(count):
+        request = pb.Request(
+            client_id=client_id, req_no=req_no, data=b"%d" % req_no
+        )
+        out.append(request)
+    return out
+
+
+def test_single_node_runtime(tmp_path):
+    transport = ThreadTransport()
+    state = standard_initial_network_state(1, [1])
+    replica = Replica(0, transport, tmp_path, initial_state=state)
+    try:
+        proposer = replica.node.client_proposer(1)
+        requests = make_requests(1, 20)
+        for request in requests:
+            proposer.propose(request)
+        await_commits([replica], {(1, r.req_no) for r in requests})
+        # Exactly once (no restarts in this test, so no replays either).
+        commits = [(c, r) for c, r, _s in replica.app_log.commits]
+        assert len(commits) == len(set(commits))
+    finally:
+        replica.stop()
+    assert replica.node.exit_error is None
+
+
+def test_four_node_runtime(tmp_path):
+    transport = ThreadTransport()
+    state = standard_initial_network_state(4, [7, 8])
+    replicas = [
+        Replica(i, transport, tmp_path, initial_state=state) for i in range(4)
+    ]
+    try:
+        requests = []
+        for client_id in (7, 8):
+            proposer = replicas[0].node.client_proposer(client_id)
+            for request in make_requests(client_id, 10):
+                requests.append(request)
+                # Clients submit to every replica.
+                for replica in replicas:
+                    replica.node.propose(request)
+        expected = {(r.client_id, r.req_no) for r in requests}
+        await_commits(replicas, expected, timeout=120)
+        for replica in replicas:
+            commits = [(c, r) for c, r, _s in replica.app_log.commits]
+            assert len(commits) == len(set(commits)), "duplicate commit!"
+        # All chains agree.
+        chains = {r.app_log.chain for r in replicas}
+        assert len(chains) == 1
+    finally:
+        for replica in replicas:
+            replica.stop()
+    assert all(r.node.exit_error is None for r in replicas)
+
+
+def test_wal_restart_resumes(tmp_path):
+    """Kill a 1-node network after commits; restart from the durable WAL
+    and verify it continues from its checkpoint."""
+    transport = ThreadTransport()
+    state = standard_initial_network_state(1, [1])
+    replica = Replica(0, transport, tmp_path, initial_state=state)
+    requests = make_requests(1, 12)
+    try:
+        proposer = replica.node.client_proposer(1)
+        for request in requests[:6]:
+            proposer.propose(request)
+        await_commits([replica], {(1, r.req_no) for r in requests[:6]})
+    finally:
+        replica.stop()
+
+    # Restart from the same directory (no initial_state → restart path).
+    replica2 = Replica(0, transport, tmp_path)
+    try:
+        deadline = time.monotonic() + 60
+        while replica2.node.status() is None:
+            assert time.monotonic() < deadline
+        proposer = replica2.node.client_proposer(1)
+        for request in requests[6:]:
+            proposer.propose(request)
+        await_commits([replica2], {(1, r.req_no) for r in requests[6:]})
+    finally:
+        replica2.stop()
+    assert replica2.node.exit_error is None
+
+
+def test_storage_roundtrip(tmp_path):
+    wal = FileWal(str(tmp_path / "wal"))
+    entries = [
+        pb.Persistent(type=pb.ECEntry(epoch_number=i)) for i in range(50)
+    ]
+    for i, entry in enumerate(entries):
+        wal.write(i, entry)
+    wal.sync()
+    wal.truncate(20)
+    wal.close()
+
+    wal2 = FileWal(str(tmp_path / "wal"))
+    loaded = []
+    wal2.load_all(lambda i, e: loaded.append((i, e)))
+    assert [i for i, _ in loaded] == list(range(20, 50))
+    assert loaded[0][1].type.epoch_number == 20
+    wal2.close()
+
+    store = FileRequestStore(str(tmp_path / "reqs"))
+    acks = [
+        pb.RequestAck(client_id=1, req_no=i, digest=bytes([i]) * 32)
+        for i in range(10)
+    ]
+    for i, ack in enumerate(acks):
+        store.store(ack, b"data%d" % i)
+    store.sync()
+    for ack in acks[:5]:
+        store.commit(ack)
+    store.sync()
+    assert store.get(acks[7]) == b"data7"
+    assert store.get(acks[2]) is None
+    store.close()
+
+    store2 = FileRequestStore(str(tmp_path / "reqs"))
+    uncommitted = []
+    store2.uncommitted(uncommitted.append)
+    assert {a.req_no for a in uncommitted} == {5, 6, 7, 8, 9}
+    store2.close()
+
+
+def test_wal_detects_torn_tail(tmp_path):
+    wal = FileWal(str(tmp_path / "wal"))
+    for i in range(5):
+        wal.write(i, pb.Persistent(type=pb.ECEntry(epoch_number=i)))
+    wal.sync()
+    wal.close()
+    # Corrupt the tail.
+    seg = next(
+        (tmp_path / "wal" / "segments").glob("*.wal")
+    )
+    data = seg.read_bytes()
+    seg.write_bytes(data[:-3])
+    wal2 = FileWal(str(tmp_path / "wal"))
+    loaded = []
+    wal2.load_all(lambda i, e: loaded.append(i))
+    assert loaded == [0, 1, 2, 3]  # the torn record is discarded
+    wal2.close()
