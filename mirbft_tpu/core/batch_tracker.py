@@ -14,10 +14,6 @@ from .actions import Actions
 from .persisted import Persisted
 
 
-class ByzantineBatchForward(Exception):
-    """A forwarded batch did not hash to its claimed digest."""
-
-
 class _Batch:
     __slots__ = ("observed_sequences", "request_acks")
 
@@ -27,12 +23,18 @@ class _Batch:
 
 
 class BatchTracker:
-    def __init__(self, persisted: Persisted):
+    def __init__(self, persisted: Persisted, logger=None):
         self.persisted = persisted
+        self.logger = logger
         self.batches_by_digest: dict[bytes, _Batch] = {}
         self.fetch_in_flight: dict[bytes, list] = {}  # digest -> [seq_no]
+        self.fetch_sources: dict[bytes, list] = {}  # digest -> [node]
 
     def reinitialize(self) -> None:
+        # Stale in-flight fetches would both re-broadcast forever and
+        # suppress (via dedup) the re-issued fetches of the rebuilt epoch
+        # target.
+        self.abandon_fetches()
         self.persisted.iterate(
             {
                 pb.QEntry: lambda q: self.add_batch(
@@ -67,16 +69,43 @@ class BatchTracker:
             self.batches_by_digest[digest] = batch
         for in_flight_seq in self.fetch_in_flight.pop(digest, ()):
             batch.observed_sequences.add(in_flight_seq)
+        self.fetch_sources.pop(digest, None)
         batch.observed_sequences.add(seq_no)
 
     def fetch_batch(self, seq_no: int, digest: bytes, sources: list) -> Actions:
         in_flight = self.fetch_in_flight.setdefault(digest, [])
+        known = self.fetch_sources.setdefault(digest, [])
+        for node in sources:
+            if node not in known:
+                known.append(node)
         if seq_no in in_flight:
             return Actions()
         in_flight.append(seq_no)
         return Actions().send(
             sources, pb.Msg(type=pb.FetchBatch(seq_no=seq_no, digest=digest))
         )
+
+    def abandon_fetches(self) -> None:
+        """Drop all in-flight fetches (the epoch target that wanted them is
+        dead; its successor re-issues whatever it still needs)."""
+        self.fetch_in_flight.clear()
+        self.fetch_sources.clear()
+
+    def retransmit_fetches(self) -> Actions:
+        """Re-send every in-flight FetchBatch to its known holders (driven
+        from the epoch target's FETCHING tick).  Without this, one lost or
+        byzantine reply would stall the epoch change forever."""
+        actions = Actions()
+        for digest in sorted(self.fetch_in_flight):
+            sources = self.fetch_sources.get(digest)
+            if not sources:
+                continue
+            for seq_no in self.fetch_in_flight[digest]:
+                actions.send(
+                    list(sources),  # snapshot: the live list may grow later
+                    pb.Msg(type=pb.FetchBatch(seq_no=seq_no, digest=digest)),
+                )
+        return actions
 
     def reply_fetch_batch(self, source: int, seq_no: int, digest: bytes) -> Actions:
         batch = self.batches_by_digest.get(digest)
@@ -115,11 +144,21 @@ class BatchTracker:
         self, digest: bytes, verify: pb.HashOriginVerifyBatch
     ) -> None:
         if verify.expected_digest != digest:
-            raise ByzantineBatchForward(
-                f"forwarded batch hashes to {digest!r}, "
-                f"claimed {verify.expected_digest!r}"
-            )
+            # A byzantine peer forwarded a batch that doesn't hash to the
+            # digest we fetched.  Drop it and leave the fetch in flight so
+            # retransmit_fetches (the epoch target's FETCHING tick) retries
+            # the known holders.  (The reference panics here; a remote peer
+            # must never crash us.)
+            if self.logger is not None:
+                self.logger.warn(
+                    "dropping forwarded batch: does not hash to its "
+                    "claimed digest",
+                    source=verify.source,
+                    seq_no=verify.seq_no,
+                )
+            return
         in_flight = self.fetch_in_flight.pop(digest, None)
+        self.fetch_sources.pop(digest, None)
         if in_flight is None:
             return  # duplicate response; already satisfied
         batch = self.batches_by_digest.get(digest)

@@ -95,7 +95,13 @@ class EpochTarget:
         self.my_leader_choice: list = []
         self.leader_new_epoch: pb.NewEpoch | None = None  # from the leader
         self.network_new_epoch: pb.NewEpochConfig | None = None  # via Bracha
-        self.is_leader = number % len(network_config.nodes) == my_config.id
+        # Epoch leader is selected from the node *list*, not by assuming IDs
+        # are contiguous 0..n-1 (the reference assumes contiguity; this holds
+        # for any ID set).
+        self.is_leader = (
+            network_config.nodes[number % len(network_config.nodes)]
+            == my_config.id
+        )
         self.prestart_buffers = {
             node: MsgBuffer(
                 f"epoch-{number}-prestart", node_buffers.node_buffer(node)
@@ -566,11 +572,17 @@ class EpochTarget:
     def _tick_pending(self) -> Actions:
         timeout = max(self.my_config.new_epoch_timeout_ticks, 2)
         pending_ticks = self.state_ticks % timeout
+        actions = Actions()
+        if self.state == TargetState.FETCHING and self.state_ticks % 2 == 0:
+            # Lost or byzantine FetchBatch replies must not stall the epoch
+            # change; re-ask the known holders.
+            actions.concat(self.batch_tracker.retransmit_fetches())
         if self.is_leader:
             if self.my_new_epoch is not None and pending_ticks % 2 == 0:
-                return Actions().send(
+                actions.send(
                     self.network_config.nodes, pb.Msg(type=self.my_new_epoch)
                 )
+                return actions
         else:
             if pending_ticks == 0:
                 # In the crash-resume path we never computed a NewEpoch;
@@ -582,10 +594,8 @@ class EpochTarget:
                     else self.number
                 )
                 suspect = pb.Suspect(epoch=epoch)
-                actions = Actions().send(
-                    self.network_config.nodes, pb.Msg(type=suspect)
-                )
+                actions.send(self.network_config.nodes, pb.Msg(type=suspect))
                 return actions.concat(self.persisted.add_suspect(suspect))
             if self.my_epoch_change is not None and pending_ticks % 2 == 0:
-                return self._repeat_epoch_change()
-        return Actions()
+                return actions.concat(self._repeat_epoch_change())
+        return actions

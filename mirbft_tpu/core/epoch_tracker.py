@@ -203,6 +203,10 @@ class EpochTracker:
         new_number = max(self.current_epoch.number + 1, self.max_correct_epoch)
         epoch_change = self.persisted.construct_epoch_change(new_number)
 
+        # Fetches issued for the dead target are stale: the next target's
+        # FETCHING phase issues its own, and retransmit_fetches must not
+        # keep re-broadcasting abandoned ones forever.
+        self.batch_tracker.abandon_fetches()
         self.current_epoch = self._new_target(new_number)
         self.current_epoch.my_epoch_change = parse_epoch_change(epoch_change)
         # Leader choice: all nodes (multi-leader; refinement of the set on
@@ -258,9 +262,9 @@ class EpochTracker:
                 source, inner.originator, inner.epoch_change
             )
         if isinstance(inner, pb.NewEpoch):
-            if inner.new_config.config.number % len(
-                self.network_config.nodes
-            ) != source:
+            nodes = self.network_config.nodes
+            leader = nodes[inner.new_config.config.number % len(nodes)]
+            if leader != source:
                 return Actions()  # not from the epoch's leader
             return target.apply_new_epoch_msg(inner)
         if isinstance(inner, pb.NewEpochEcho):

@@ -70,7 +70,7 @@ class StateMachine:
         self.commit_state = CommitState(
             self.persisted, self.client_tracker, self.logger
         )
-        self.batch_tracker = BatchTracker(self.persisted)
+        self.batch_tracker = BatchTracker(self.persisted, self.logger)
         self.epoch_tracker = EpochTracker(
             self.persisted,
             self.node_buffers,
@@ -268,14 +268,25 @@ class StateMachine:
                 )
             elif isinstance(origin, pb.HashOriginVerifyRequest):
                 if origin.request_ack.digest != digest:
-                    raise AssertionError(
-                        "forwarded request data does not match its ack digest"
+                    # A byzantine peer forwarded request data that does not
+                    # hash to the ack's digest.  Drop it — the fetch/refetch
+                    # tick machinery retries against other ackers.  (The
+                    # reference panics here, marked "XXX this should not
+                    # panic"; a remote peer must never crash the node.)
+                    if self.logger is not None:
+                        self.logger.warn(
+                            "dropping forwarded request: data does not "
+                            "match its ack digest",
+                            source=origin.source,
+                            client_id=origin.request_ack.client_id,
+                            req_no=origin.request_ack.req_no,
+                        )
+                else:
+                    actions.concat(
+                        self.client_tracker.apply_request_digest(
+                            origin.request_ack, origin.request_data
+                        )
                     )
-                actions.concat(
-                    self.client_tracker.apply_request_digest(
-                        origin.request_ack, origin.request_data
-                    )
-                )
             elif isinstance(origin, pb.HashOriginEpochChange):
                 actions.concat(
                     self.epoch_tracker.apply_epoch_change_digest(origin, digest)

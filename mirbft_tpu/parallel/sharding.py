@@ -28,14 +28,15 @@ from ..ops.sha256 import _sha256_blocks
 AXIS = "crypto"
 
 
-def make_mesh(n_devices: int | None = None) -> Mesh:
-    devices = jax.devices()
-    if n_devices is not None and len(devices) < n_devices:
-        # The default platform (e.g. a single tunneled TPU chip) may have
-        # fewer devices than requested; the virtual CPU mesh
-        # (--xla_force_host_platform_device_count) still lets the multi-chip
-        # program compile and run.
-        devices = jax.devices("cpu")
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            # The default platform (e.g. a single tunneled TPU chip) may have
+            # fewer devices than requested; the virtual CPU mesh
+            # (--xla_force_host_platform_device_count) still lets the
+            # multi-chip program compile and run.
+            devices = jax.devices("cpu")
     if n_devices is not None:
         if len(devices) < n_devices:
             raise ValueError(
@@ -73,8 +74,11 @@ def sharded_sha256(mesh: Mesh):
         )(blocks, n_blocks)
 
     def run(blocks, n_blocks):
-        blocks = jax.device_put(jnp.asarray(blocks), batch_sharding)
-        n_blocks = jax.device_put(jnp.asarray(n_blocks), batch_sharding)
+        # device_put numpy straight onto the mesh sharding: routing through
+        # jnp.asarray first would commit the array to the *default* device
+        # (possibly a TPU client unrelated to this mesh) before re-sharding.
+        blocks = jax.device_put(np.asarray(blocks), batch_sharding)
+        n_blocks = jax.device_put(np.asarray(n_blocks), batch_sharding)
         return digest(blocks, n_blocks)
 
     return run
@@ -101,9 +105,14 @@ def sharded_quorum_tally(mesh: Mesh):
         )
     )
 
+    votes_sharding = NamedSharding(mesh, P(AXIS, None))
+    replicated = NamedSharding(mesh, P())
+
     def run(votes, threshold):
-        votes = jnp.asarray(votes)
-        threshold = jnp.asarray(threshold, dtype=jnp.int32)
+        votes = jax.device_put(np.asarray(votes), votes_sharding)
+        threshold = jax.device_put(
+            np.asarray(threshold, dtype=np.int32), replicated
+        )
         return fn(votes, threshold)
 
     return run

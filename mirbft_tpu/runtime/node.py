@@ -171,9 +171,14 @@ class Node:
     def ready(self, timeout: float | None = None):
         """Block for the next batch of Actions; None on timeout/stop."""
         try:
-            return self._outbox.get(timeout=timeout)
+            actions = self._outbox.get(timeout=timeout)
         except queue.Empty:
             return None
+        # Wake the serializer: actions accumulated while the one-slot outbox
+        # was full should be handed off now, not when the next inbound event
+        # (often a whole tick later) arrives.
+        self._inbox.put(("wake",))
+        return actions
 
     def client_proposer(self, client_id: int, blocking: bool = True):
         waiter = self._request_waiter(client_id)
@@ -273,6 +278,8 @@ class Node:
                 kind = item[0]
                 if kind == "stop":
                     return
+                if kind == "wake":
+                    continue  # flush retried at the top of the loop
                 if kind == "step":
                     self._apply(
                         pb.StateEvent(

@@ -4,7 +4,7 @@ the outstanding-reqs in-order checker, and the batch tracker fetch path."""
 import pytest
 
 from mirbft_tpu import pb
-from mirbft_tpu.core.batch_tracker import BatchTracker, ByzantineBatchForward
+from mirbft_tpu.core.batch_tracker import BatchTracker
 from mirbft_tpu.core.client_tracker import ClientTracker
 from mirbft_tpu.core.msgbuffers import NodeBuffers
 from mirbft_tpu.core.outstanding import InvalidPreprepare, OutstandingReqs
@@ -204,11 +204,34 @@ def test_batch_tracker_fetch_verify_cycle():
     assert bt.get_batch(digest) is not None
     assert 5 in bt.get_batch(digest).observed_sequences
 
-    with pytest.raises(ByzantineBatchForward):
-        bt.apply_verify_batch_hash_result(
-            b"wrong",
-            pb.HashOriginVerifyBatch(expected_digest=digest, request_acks=acks),
-        )
+    # A byzantine forward (hash mismatch) is dropped without crashing and
+    # leaves any in-flight fetch untouched.
+    bt2 = BatchTracker(persisted)
+    bt2.fetch_batch(9, digest, [1, 2])
+    bt2.apply_verify_batch_hash_result(
+        b"wrong",
+        pb.HashOriginVerifyBatch(expected_digest=digest, request_acks=acks),
+    )
+    assert bt2.has_fetch_in_flight()
+    assert bt2.get_batch(digest) is None
+
+
+def test_batch_tracker_retransmits_in_flight_fetches():
+    persisted = Persisted()
+    bt = BatchTracker(persisted)
+    acks = [pb.RequestAck(client_id=7, req_no=0, digest=b"\xbb" * 32)]
+    digest = host_digest([a.digest for a in acks])
+
+    bt.fetch_batch(5, digest, [1, 2])
+    [send] = bt.retransmit_fetches().sends
+    assert send.targets == [1, 2]
+    assert isinstance(send.msg.type, pb.FetchBatch)
+    assert send.msg.type.seq_no == 5 and send.msg.type.digest == digest
+
+    # A satisfied fetch stops retransmitting.
+    bt.add_batch(5, digest, acks)
+    assert bt.retransmit_fetches().is_empty()
+    assert not bt.fetch_sources
 
 
 def test_batch_tracker_reinit_and_truncate():
