@@ -131,6 +131,13 @@ def sha256_digest_words(blocks, n_blocks):
     return _sha256_blocks(blocks, n_blocks, max_blocks=blocks.shape[1])
 
 
+def sha256_chunked(chunk_lists: list) -> list:
+    """Digest a batch of chunked preimages (the Actions.hashes shape: each
+    item is a list of byte chunks, digested over their concatenation).  The
+    executor-facing entry point for offloading a whole action batch."""
+    return sha256_many([b"".join(chunks) for chunks in chunk_lists])
+
+
 def sha256(message: bytes) -> bytes:
     """Single-message convenience wrapper (prefer sha256_many for batches)."""
     return sha256_many([message])[0]

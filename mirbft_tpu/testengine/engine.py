@@ -113,6 +113,7 @@ class Recorder:
         batch_size: int = 1,
         interceptor=None,
         manglers=(),
+        hash_executor=None,
     ):
         self.params = params or RuntimeParameters()
         self.rng = random.Random(seed)
@@ -121,6 +122,12 @@ class Recorder:
         self.batch_size = batch_size
         self.interceptor = interceptor
         self.manglers = list(manglers)
+        # Pluggable digest executor: fn(list of chunk-lists) -> list of
+        # digests.  Default is host hashlib; passing ops.sha256.sha256_chunked
+        # runs every digest of the simulation on the accelerator — event
+        # counts and app chains must come out identical (determinism carries
+        # over the Actions seam, SURVEY §7).
+        self.hash_executor = hash_executor
 
         client_ids = [node_count + i for i in range(client_count)]
         self.initial_state = standard_initial_network_state(
@@ -335,10 +342,13 @@ class Recorder:
                 )
 
         results = act.ActionResults()
-        for hr in actions.hashes:
-            results.digests.append(
-                act.HashResult(digest=host_digest(hr.data), request=hr)
-            )
+        if actions.hashes:
+            if self.hash_executor is not None:
+                digests = self.hash_executor([hr.data for hr in actions.hashes])
+            else:
+                digests = [host_digest(hr.data) for hr in actions.hashes]
+            for hr, digest in zip(actions.hashes, digests, strict=True):
+                results.digests.append(act.HashResult(digest=digest, request=hr))
 
         for commit in actions.commits:
             if commit.batch is not None:

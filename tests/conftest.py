@@ -9,7 +9,10 @@ set before jax is imported anywhere in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not default): the ambient environment may export JAX_PLATFORMS=axon
+# (the tunneled TPU), and running the suite's many tiny kernel dispatches
+# through the tunnel is both slow and non-hermetic.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
