@@ -216,7 +216,12 @@ class Recorder:
         events.append(pb.StateEvent(type=pb.EventCompleteInitialization()))
 
         for event in events:
-            self._schedule(at_time - self.now, node, event)
+            # Boot lifecycle events bypass manglers (and the crashed-node
+            # filter): they are harness machinery, not network traffic — a
+            # node-scoped drop/jitter mangler must not break the strict
+            # Initialize→Load→Complete sequence.
+            heapq.heappush(self._queue, (at_time, self._seq, node, event))
+            self._seq += 1
 
     # -- scheduling ----------------------------------------------------------
 
@@ -225,12 +230,32 @@ class Recorder:
         if state is not None and state.crashed:
             return  # a down node loses its inbound traffic
         when = self.now + delay
+        # Mangler protocol: each mangler maps one candidate to None (drop),
+        # a (when, node, event) tuple, or a list of tuples (duplication);
+        # manglers fold left over the candidate set.
+        candidates = [(when, node, event)]
         for mangler in self.manglers:
-            verdict = mangler(self, when, node, event)
-            if verdict is None:
-                return  # dropped
-            when, node, event = verdict
-        heapq.heappush(self._queue, (when, self._seq, node, event))
+            folded = []
+            for w, n, e in candidates:
+                verdict = mangler(self, w, n, e)
+                if verdict is None:
+                    continue
+                if isinstance(verdict, list):
+                    folded.extend(verdict)
+                else:
+                    folded.append(verdict)
+            candidates = folded
+        for w, n, e in candidates:
+            heapq.heappush(self._queue, (w, self._seq, n, e))
+            self._seq += 1
+
+    def schedule_restart(self, node: int, delay: int) -> None:
+        """Schedule a node (possibly crashed) to boot from its durable state
+        at now+delay.  Bypasses manglers and crash filtering: the restart is
+        harness machinery, not network traffic."""
+        heapq.heappush(
+            self._queue, (self.now + delay, self._seq, node, _RESTART)
+        )
         self._seq += 1
 
     def _submit_next_request(self, client: _ClientState, at_delay: int) -> None:
@@ -253,6 +278,9 @@ class Recorder:
             return False
         when, _seq, node, event = heapq.heappop(self._queue)
         self.now = max(self.now, when)
+        if event is _RESTART:
+            self.restart(node)
+            return True
         machine = self.machines[node]
         state = self.node_states[node]
         if state.crashed:
@@ -445,7 +473,9 @@ class Recorder:
     def crash(self, node: int) -> None:
         self.node_states[node].crashed = True
         self._queue = [
-            entry for entry in self._queue if entry[2] != node
+            entry
+            for entry in self._queue
+            if entry[2] != node or entry[3] is _RESTART
         ]
         heapq.heapify(self._queue)
 
@@ -463,14 +493,29 @@ class Recorder:
             n for n in range(self.node_count)
             if not self.node_states[n].crashed
         ]
-        for node in live_nodes:
-            seen = sum(
-                len(c.committed_by_node.get(node, ()))
-                for c in self.clients.values()
-            )
-            if seen < total:
-                return False
-        return True
+        return all(self.committed_at(node) >= total for node in live_nodes)
+
+    def drain_until(self, predicate, max_steps: int = 100_000) -> int:
+        """Run until predicate(self) holds; returns events processed."""
+        for _ in range(max_steps):
+            if predicate(self):
+                return self.event_count
+            if not self.step():
+                raise AssertionError(
+                    f"event queue drained before condition "
+                    f"({self.event_count} events)"
+                )
+        raise AssertionError(
+            f"condition not reached after {max_steps} steps "
+            f"({self.event_count} events)"
+        )
+
+    def committed_at(self, node: int) -> int:
+        """Distinct requests committed (or adopted via transfer) at node."""
+        return sum(
+            len(c.committed_by_node.get(node, ()))
+            for c in self.clients.values()
+        )
 
     def drain_clients(self, max_steps: int = 100_000) -> int:
         """Run until every client's requests commit at every live node;
@@ -487,6 +532,17 @@ class Recorder:
             f"no full commitment after {max_steps} steps "
             f"({self.event_count} events)"
         )
+
+
+class _RestartSentinel:
+    """Queue marker: boot this node when popped (sorts after real events at
+    the same (when, seq) because it is never compared — seq breaks ties)."""
+
+    def __repr__(self):
+        return "<restart>"
+
+
+_RESTART = _RestartSentinel()
 
 
 def _tick_event() -> pb.StateEvent:

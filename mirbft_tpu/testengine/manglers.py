@@ -1,0 +1,249 @@
+"""Fault-injection mangler DSL for the testengine.
+
+Rebuild of the reference's mangler language (reference:
+testengine/manglers.go:45-718): composable predicates over scheduled
+events, temporal combinators, and the actions Drop / Delay / Jitter /
+Duplicate / CrashAndRestartAfter.  A mangler is a callable
+``(recorder, when, node, event) -> verdict`` where the verdict is ``None``
+(drop), one ``(when, node, event)`` tuple, or a list of tuples
+(duplication); the engine folds the candidate set through every mangler
+(engine._schedule).
+
+All randomness draws from ``recorder.rng`` so mangled runs stay
+reproducible from the seed.
+
+Usage (mirroring the reference's scenarios, mirbft_test.go:68-222)::
+
+    rule(is_step()).jitter(30)                              # 30ms jitter
+    rule(is_step(), percent(75)).duplicate(300)             # 75% duplication
+    rule(msg_type("RequestAck"), from_source(1, 2),
+         percent(70)).drop()                                # targeted ack loss
+    rule(to_node(1), after_events(30), once()
+         ).crash_and_restart_after(5000)                    # crash + reboot
+"""
+
+from __future__ import annotations
+
+from .. import pb
+
+
+# ---------------------------------------------------------------------------
+# Predicates: (recorder, when, node, event) -> bool
+# ---------------------------------------------------------------------------
+
+
+def is_step():
+    """Matches inbound network messages (EventStep) — what 'the network'
+    can observe and disturb."""
+
+    def pred(_recorder, _when, _node, event):
+        return isinstance(event.type, pb.EventStep)
+
+    return pred
+
+
+def event_type(*names: str):
+    def pred(_recorder, _when, _node, event):
+        return type(event.type).__name__ in names
+
+    return pred
+
+
+def msg_type(*names: str):
+    """Matches EventStep events carrying one of these message kinds."""
+
+    def pred(_recorder, _when, _node, event):
+        inner = event.type
+        return (
+            isinstance(inner, pb.EventStep)
+            and inner.msg is not None
+            and type(inner.msg.type).__name__ in names
+        )
+
+    return pred
+
+
+def from_source(*sources: int):
+    """Matches EventStep events sent by one of these nodes."""
+
+    def pred(_recorder, _when, _node, event):
+        inner = event.type
+        return isinstance(inner, pb.EventStep) and inner.source in sources
+
+    return pred
+
+
+def to_node(*nodes: int):
+    """Matches events delivered to one of these nodes."""
+
+    def pred(_recorder, _when, node, _event):
+        return node in nodes
+
+    return pred
+
+
+def from_client(*client_ids: int):
+    """Matches proposals and request acks of these clients."""
+
+    def pred(_recorder, _when, _node, event):
+        inner = event.type
+        if isinstance(inner, pb.EventPropose) and inner.request is not None:
+            return inner.request.client_id in client_ids
+        if (
+            isinstance(inner, pb.EventStep)
+            and inner.msg is not None
+            and isinstance(inner.msg.type, pb.RequestAck)
+        ):
+            return inner.msg.type.client_id in client_ids
+        return False
+
+    return pred
+
+
+def with_seq_no(low: int, high: int):
+    """Matches 3-phase messages whose seq_no lies in [low, high]."""
+
+    def pred(_recorder, _when, _node, event):
+        inner = event.type
+        if not isinstance(inner, pb.EventStep) or inner.msg is None:
+            return False
+        msg = inner.msg.type
+        seq = getattr(msg, "seq_no", None)
+        return seq is not None and low <= seq <= high
+
+    return pred
+
+
+def percent(p: float):
+    """Matches p% of the events reaching it (seeded rng)."""
+
+    def pred(recorder, _when, _node, _event):
+        return recorder.rng.random() * 100 < p
+
+    return pred
+
+
+# Temporal combinators (stateful; one instance per rule).
+
+
+def after_events(n: int):
+    """Matches only from the n-th candidate event this predicate sees."""
+    seen = [0]
+
+    def pred(_recorder, _when, _node, _event):
+        seen[0] += 1
+        return seen[0] > n
+
+    return pred
+
+
+def until_events(n: int):
+    """Matches only the first n candidate events this predicate sees."""
+    seen = [0]
+
+    def pred(_recorder, _when, _node, _event):
+        seen[0] += 1
+        return seen[0] <= n
+
+    return pred
+
+
+def after_time(ms: int):
+    def pred(_recorder, when, _node, _event):
+        return when >= ms
+
+    return pred
+
+
+def until_time(ms: int):
+    def pred(_recorder, when, _node, _event):
+        return when < ms
+
+    return pred
+
+
+def once():
+    """Matches exactly one event (combine after the other predicates)."""
+    fired = [False]
+
+    def pred(_recorder, _when, _node, _event):
+        if fired[0]:
+            return False
+        fired[0] = True
+        return True
+
+    return pred
+
+
+# ---------------------------------------------------------------------------
+# Rules and actions
+# ---------------------------------------------------------------------------
+
+
+class _Rule:
+    """Predicates are AND-ed left to right; later (stateful temporal)
+    predicates only see events the earlier ones matched — so
+    ``rule(msg_type("Prepare"), until_events(5))`` means 'the first five
+    Prepares', like the reference's fluent chains."""
+
+    def __init__(self, predicates):
+        self.predicates = list(predicates)
+
+    def _matches(self, recorder, when, node, event) -> bool:
+        return all(
+            predicate(recorder, when, node, event)
+            for predicate in self.predicates
+        )
+
+    def drop(self):
+        def mangler(recorder, when, node, event):
+            if self._matches(recorder, when, node, event):
+                return None
+            return (when, node, event)
+
+        return mangler
+
+    def delay(self, ms: int):
+        def mangler(recorder, when, node, event):
+            if self._matches(recorder, when, node, event):
+                return (when + ms, node, event)
+            return (when, node, event)
+
+        return mangler
+
+    def jitter(self, max_ms: int):
+        def mangler(recorder, when, node, event):
+            if self._matches(recorder, when, node, event):
+                return (when + recorder.rng.randint(0, max_ms), node, event)
+            return (when, node, event)
+
+        return mangler
+
+    def duplicate(self, max_delay_ms: int):
+        def mangler(recorder, when, node, event):
+            if self._matches(recorder, when, node, event):
+                echo = when + recorder.rng.randint(1, max(max_delay_ms, 1))
+                return [(when, node, event), (echo, node, event)]
+            return (when, node, event)
+
+        return mangler
+
+    def crash_and_restart_after(self, delay_ms: int, node: int | None = None):
+        """On match, crash the event's target node (or the given node) and
+        boot it from its durable state delay_ms later (reference:
+        manglers.go:696-718, which injects a fresh Initialize).  Combine
+        with once() unless repeated crashes are intended."""
+
+        def mangler(recorder, when, target, event):
+            if self._matches(recorder, when, target, event):
+                victim = node if node is not None else target
+                recorder.crash(victim)
+                recorder.schedule_restart(victim, delay_ms)
+                return None  # the triggering event dies with the node
+            return (when, target, event)
+
+        return mangler
+
+
+def rule(*predicates) -> _Rule:
+    return _Rule(predicates)

@@ -1,0 +1,49 @@
+"""State transfer exercised end-to-end (VERDICT r2 item 5; reference:
+commitstate.go:103-116, mirbft_test.go:157-170 late-start scenario): a node
+that falls behind past garbage collection must emit a transfer request,
+adopt a peer checkpoint, and converge to the common chain."""
+
+from mirbft_tpu import pb
+from mirbft_tpu.testengine import BasicRecorder
+
+
+def test_late_starting_node_adopts_state():
+    """The reference's late-start scenario: node 3 is down from t=0 while
+    the other three commit 80 requests (4 checkpoint windows — far past
+    GC); on reboot it must state-transfer, not replay."""
+    r = BasicRecorder(node_count=4, client_count=2, reqs_per_client=40)
+    r.crash(3)
+    r.schedule_restart(3, 40_000)
+    r.drain_clients(max_steps=1_000_000)
+
+    total = 2 * 40
+    r.drain_until(lambda rec: rec.committed_at(3) >= total, max_steps=1_000_000)
+
+    # A transfer was actually adopted (not replayed commit-by-commit).
+    adopted = [
+        (t, n)
+        for (t, n, e) in r.recorded_events
+        if isinstance(e.type, pb.EventTransfer)
+        and e.type.c_entry.network_state is not None
+    ]
+    assert adopted and all(n == 3 for _t, n in adopted)
+
+    chains = {n: r.node_states[n].app_chain for n in range(4)}
+    assert len(set(chains.values())) == 1 and chains[3] != b""
+
+
+def test_crash_past_gc_then_restart_transfers():
+    """Crash a node mid-run, keep the network going past GC, restart:
+    the rebooted node transfers forward instead of stalling."""
+    r = BasicRecorder(node_count=4, client_count=2, reqs_per_client=40)
+
+    # Let everyone commit a little, then take node 2 down.
+    r.drain_until(lambda rec: rec.committed_at(2) >= 10, max_steps=1_000_000)
+    r.crash(2)
+    r.schedule_restart(2, 60_000)
+    r.drain_clients(max_steps=1_000_000)
+
+    total = 2 * 40
+    r.drain_until(lambda rec: rec.committed_at(2) >= total, max_steps=1_000_000)
+    chains = {n: r.node_states[n].app_chain for n in range(4)}
+    assert len(set(chains.values())) == 1
