@@ -23,9 +23,17 @@ from .actions import Actions, CheckpointReq, CommitAction, StateTarget
 from .persisted import Persisted
 
 
-def next_network_config(starting_state: pb.NetworkState, client_configs: list):
+def next_network_config(
+    starting_state: pb.NetworkState, client_configs: list, logger=None
+):
     """Apply pending reconfigurations to produce the next config + client
-    set (reference: commitstate.go:192-226)."""
+    set (reference: commitstate.go:192-226).
+
+    Applications must be idempotent: after a reconfiguration reinitialize
+    (or a crash replay) the same pending list is re-applied over client
+    states that may already reflect it — so an add of an existing id and a
+    remove of an absent id are skipped.  Skips are logged: on *first*
+    application they indicate a bad app-issued reconfiguration."""
     if not starting_state.pending_reconfigurations:
         return starting_state.config, client_configs
 
@@ -34,15 +42,25 @@ def next_network_config(starting_state: pb.NetworkState, client_configs: list):
     for reconfig in starting_state.pending_reconfigurations:
         change = reconfig.type
         if isinstance(change, pb.ReconfigNewClient):
-            next_clients.append(
-                pb.NetworkClient(id=change.id, width=change.width)
-            )
+            if all(c.id != change.id for c in next_clients):
+                next_clients.append(
+                    pb.NetworkClient(id=change.id, width=change.width)
+                )
+            elif logger is not None:
+                logger.warn(
+                    "skipping reconfiguration: client already exists "
+                    "(replay, or a conflicting app-issued add)",
+                    client_id=change.id,
+                )
         elif isinstance(change, pb.ReconfigRemoveClient):
-            remaining = [c for c in next_clients if c.id != change.client_id]
-            if len(remaining) == len(next_clients):
-                raise AssertionError(
-                    f"asked to remove client {change.client_id} which "
-                    f"doesn't exist"
+            remaining = [
+                c for c in next_clients if c.id != change.client_id
+            ]
+            if len(remaining) == len(next_clients) and logger is not None:
+                logger.warn(
+                    "skipping reconfiguration: client to remove not "
+                    "present (replay, or a bad app-issued remove)",
+                    client_id=change.client_id,
                 )
             next_clients = remaining
         elif isinstance(change, pb.NetworkConfig):
@@ -68,6 +86,21 @@ class CommitState:
         self.checkpoint_pending = False
         self.transferring = False
         self.transfer_target: StateTarget | None = None
+        # Set when a checkpoint result activates a pending reconfiguration:
+        # the dispatcher must reinitialize every tracker from the log so the
+        # new config/client set takes effect (the "common reconfiguration /
+        # state transfer path" the reference aspires to at
+        # state_machine.go:124 but never wires up — reconfig is its known
+        # WIP hole; this rebuild closes it).
+        self.reconfigured = False
+        self.highest_persisted_checkpoint = 0
+        # Epoch-change replay commits beyond the current stop: a correct
+        # peer only prepared past a reconfiguration stop after that
+        # checkpoint went stable, so these are guaranteed to become
+        # committable once our own checkpoint result extends the stop —
+        # hold them here until it does (drain flushes them).  The reference
+        # has no equivalent and would panic in commit() in this scenario.
+        self.deferred_replays: list = []  # [pb.QEntry], ascending
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -109,6 +142,13 @@ class CommitState:
         self.lower_half = [None] * ci
         self.upper_half = [None] * ci
         self.checkpoint_pending = False
+        self.reconfigured = False
+        self.highest_persisted_checkpoint = last_c.seq_no
+        # Deferred replays were persisted as QEntries before being deferred;
+        # the continued epoch change re-replays them from the log, so stale
+        # in-memory copies (possibly from an abandoned target) must not
+        # survive a reinitialize.
+        self.deferred_replays = []
 
         if last_t is None or last_c.seq_no >= last_t.seq_no:
             self.transferring = False
@@ -163,19 +203,35 @@ class CommitState:
             self.stop_at_seq_no = result.seq_no + 2 * ci
         # else: pending reconfigurations — do not extend the stop.
 
+        activates_reconfig = bool(self.active_state.pending_reconfigurations)
         self.active_state = result.network_state
         self.lower_half = self.upper_half
         self.upper_half = [None] * ci
         self.low_watermark = result.seq_no
         self.checkpoint_pending = False
 
-        actions = self.persisted.add_c_entry(
-            pb.CEntry(
-                seq_no=result.seq_no,
-                checkpoint_value=result.value,
-                network_state=result.network_state,
+        actions = Actions()
+        if result.seq_no > self.highest_persisted_checkpoint:
+            if activates_reconfig:
+                # This result was computed via next_network_config over the
+                # pending reconfigurations: the new config/client set is
+                # now active, pending a full tracker reinitialize.  Only on
+                # first sight of this checkpoint — the post-reinitialize
+                # recompute of the same seq_no must not re-trigger, or
+                # activation would loop forever.
+                self.reconfigured = True
+            actions.concat(
+                self.persisted.add_c_entry(
+                    pb.CEntry(
+                        seq_no=result.seq_no,
+                        checkpoint_value=result.value,
+                        network_state=result.network_state,
+                    )
+                )
             )
-        )
+            self.highest_persisted_checkpoint = result.seq_no
+        # else: recomputed after a reconfiguration reinitialize — the CEntry
+        # is already durable; re-appending would duplicate it in the log.
         actions.send(
             self.active_state.config.nodes,
             pb.Msg(type=pb.Checkpoint(seq_no=result.seq_no, value=result.value)),
@@ -218,10 +274,28 @@ class CommitState:
         else:
             commits[offset] = q_entry
 
+    def defer_replay(self, q_entry: pb.QEntry) -> None:
+        """Hold an epoch-change replay commit that is beyond the current
+        stop until the stop extends (see deferred_replays above).  Newest
+        wins per sequence: a later epoch change may legitimately select a
+        different digest for the same seq_no than an abandoned one did."""
+        self.deferred_replays = [
+            d for d in self.deferred_replays if d.seq_no != q_entry.seq_no
+        ]
+        self.deferred_replays.append(q_entry)
+        self.deferred_replays.sort(key=lambda q: q.seq_no)
+
     def drain(self) -> list:
         """All in-order commits ready for the application, interleaved with
         checkpoint requests at window boundaries (reference:
         commitstate.go:229-279)."""
+        while (
+            self.deferred_replays
+            and self.deferred_replays[0].seq_no <= self.stop_at_seq_no
+            and not self.transferring
+        ):
+            self.commit(self.deferred_replays.pop(0))
+
         ci = self.active_state.config.checkpoint_interval
         result: list[CommitAction] = []
 
@@ -236,7 +310,7 @@ class CommitState:
                     )
                 )
                 network_config, client_configs = next_network_config(
-                    self.active_state, client_state
+                    self.active_state, client_state, self.logger
                 )
                 result.append(
                     CommitAction(

@@ -311,18 +311,13 @@ class EpochTarget:
 
         self.state = TargetState.ECHOING
 
-        if (
-            new_config.starting_checkpoint.seq_no == self.commit_state.stop_at_seq_no
-            and new_config.final_preprepares
-        ):
-            # Reconfiguration boundary: a correct replica prepared beyond the
-            # stop, so this checkpoint is stable and we must reinitialize
-            # under the new configuration before continuing.  The reference
-            # leaves this as a panic (epoch_target.go:282-300); we surface a
-            # clear error until reconfig-across-epoch-change is supported.
-            raise NotImplementedError(
-                "final preprepares span a reconfiguration boundary"
-            )
+        # Reconfiguration boundary (the spot the reference leaves as a
+        # panic, epoch_target.go:282-300): final preprepares extending past
+        # a reconfiguration stop are handled downstream — check_ready_quorum
+        # defers over-stop replay commits until our checkpoint result
+        # extends the stop (commit_state.defer_replay), so nothing special
+        # is needed here.  A correct replica only prepared beyond the stop
+        # once that checkpoint was stable, so the extension is guaranteed.
 
         actions.concat(
             self.persisted.add_n_entry(
@@ -444,8 +439,16 @@ class EpochTarget:
             current_epoch = False
 
             def on_q(q_entry):
-                if current_epoch:
+                if not current_epoch:
+                    return
+                if q_entry.seq_no <= self.commit_state.stop_at_seq_no:
                     self.commit_state.commit(q_entry)
+                else:
+                    # Beyond our (stale, pre-reconfiguration) stop: a
+                    # correct peer only prepared past the stop once that
+                    # checkpoint was stable, so hold the commit until our
+                    # own checkpoint result extends the stop.
+                    self.commit_state.defer_replay(q_entry)
 
             def on_ec(ec_entry):
                 nonlocal current_epoch

@@ -172,8 +172,13 @@ class EpochTracker:
             if (
                 self.current_epoch is not None
                 and self.current_epoch.number == last_ec.epoch_number
+                and self.current_epoch.network_config
+                == self.network_config
             ):
-                # Reinitialized mid-epoch-change: continue it.
+                # Reinitialized mid-epoch-change: continue it.  (Only while
+                # the network config is unchanged — a reconfiguration that
+                # altered the node set / f must rebuild the target so its
+                # quorum math and send lists use the new config.)
                 return actions.concat(self.current_epoch.advance_state())
 
             epoch_change = self.persisted.construct_epoch_change(

@@ -178,6 +178,12 @@ class Persisted:
                     )
                 )
             elif isinstance(entry, pb.CEntry):
+                if checkpoints and checkpoints[-1].seq_no == entry.seq_no:
+                    # A checkpoint recomputed after a reconfiguration
+                    # reinitialize can appear twice; keep the newest (the
+                    # reference emits the duplicate — its parse-side dup
+                    # check is a no-op bug, epoch_change.go:70-78).
+                    checkpoints.pop()
                 checkpoints.append(
                     pb.Checkpoint(seq_no=entry.seq_no, value=entry.checkpoint_value)
                 )

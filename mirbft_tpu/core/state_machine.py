@@ -181,8 +181,18 @@ class StateMachine:
 
         # At most one watermark movement is possible per event (a new
         # checkpoint of our own can only follow the previous checkpoint
-        # result).
-        if self.checkpoint_tracker.garbage_collectable:
+        # result).  Truncation requires an ACTIVE epoch: between an ECEntry
+        # (or a reconfiguration reinitialize) and the next epoch becoming
+        # active, the log must stay intact so an identical epoch change can
+        # be recomputed after a crash — and so the log never degenerates to
+        # a bare CEntry with no epoch marker (the reference states this
+        # discipline in docs/WALMovement.md:34-36 but does not enforce it).
+        epoch_active = (
+            self.epoch_tracker.current_epoch is not None
+            and self.epoch_tracker.current_epoch.state
+            == TargetState.IN_PROGRESS
+        )
+        if self.checkpoint_tracker.garbage_collectable and epoch_active:
             new_low = self.checkpoint_tracker.garbage_collect()
             actions.concat(self.persisted.truncate(new_low))
             self.client_tracker.garbage_collect(new_low)
@@ -241,6 +251,14 @@ class StateMachine:
                     epoch_config, checkpoint_result
                 )
             )
+            if self.commit_state.reconfigured:
+                # A pending reconfiguration just activated: the CEntry with
+                # the new network state is in the log; rebuild every tracker
+                # from it.  (The resumed epoch sends a precautionary
+                # Suspect, so the network rolls into a fresh epoch under
+                # the new configuration.)
+                self.commit_state.reconfigured = False
+                actions.concat(self._reinitialize())
 
         for hash_result in results.digests:
             origin = hash_result.type

@@ -82,6 +82,10 @@ class NodeState:
     checkpoints: dict = field(default_factory=dict)  # seq -> (value, state)
     committed_reqs: list = field(default_factory=list)  # [(client, req_no, seq)]
     crashed: bool = False
+    # Reconfigurations the app observed committed in the current checkpoint
+    # window (reported with the next CheckpointResult, reference:
+    # actions.go:234-261).
+    pending_reconfigs: list = field(default_factory=list)
 
 
 @dataclass
@@ -135,10 +139,13 @@ class Recorder:
         )
         self.initial_checkpoint_value = b""
 
-        self.clients = {
-            cid: _ClientState(client_id=cid, total_reqs=reqs_per_client)
-            for cid in client_ids
-        }
+        self.clients = {}
+        # (client_id, req_no) -> [pb.Reconfiguration]: the deterministic
+        # app-level reconfig model — when that request commits at a node,
+        # the node's app reports the reconfigurations with its next
+        # checkpoint (every correct node commits the same batches, so all
+        # report identically).
+        self.reconfig_on_commit: dict = {}
 
         self.event_count = 0
         self.recorded_events: list = []  # [(time, node, pb.StateEvent)]
@@ -153,10 +160,8 @@ class Recorder:
             self._schedule(self.params.tick_interval, node, _tick_event())
 
         # Clients submit their initial window of requests to every node.
-        for client in self.clients.values():
-            initial = min(client.total_reqs, 100)
-            for _ in range(initial):
-                self._submit_next_request(client, at_delay=0)
+        for cid in client_ids:
+            self.add_client(cid, reqs_per_client)
 
     # -- bootstrap -----------------------------------------------------------
 
@@ -384,6 +389,8 @@ class Recorder:
             else:
                 cp = commit.checkpoint
                 value = state.app_chain
+                reconfigs = state.pending_reconfigs
+                state.pending_reconfigs = []
                 # Snapshot the app state (chain + per-client commits) so a
                 # lagging node can adopt it wholesale via state transfer.
                 snapshot = {
@@ -399,7 +406,11 @@ class Recorder:
                     snapshot,
                 )
                 results.checkpoints.append(
-                    act.CheckpointResult(checkpoint=cp, value=value)
+                    act.CheckpointResult(
+                        checkpoint=cp,
+                        value=value,
+                        reconfigurations=reconfigs,
+                    )
                 )
 
         if results.digests or results.checkpoints:
@@ -412,9 +423,20 @@ class Recorder:
         if actions.state_transfer is not None:
             self._serve_state_transfer(node, actions.state_transfer)
 
+    def add_client(self, client_id: int, total_reqs: int) -> None:
+        """Register a (reconfiguration-added) client and submit its initial
+        request window to every node."""
+        client = _ClientState(client_id=client_id, total_reqs=total_reqs)
+        self.clients[client_id] = client
+        for _ in range(min(total_reqs, 100)):
+            self._submit_next_request(client, at_delay=0)
+
     def _apply_batch(self, node: int, state: NodeState, batch: pb.QEntry) -> None:
         state.last_committed = batch.seq_no
         for ack in batch.requests:
+            triggered = self.reconfig_on_commit.get((ack.client_id, ack.req_no))
+            if triggered:
+                state.pending_reconfigs.extend(triggered)
             h = hashlib.sha256()
             h.update(state.app_chain)
             h.update(ack.digest)
@@ -486,7 +508,7 @@ class Recorder:
     # -- assertions ----------------------------------------------------------
 
     def fully_committed(self) -> bool:
-        total = self.reqs_per_client * len(self.clients)
+        total = sum(c.total_reqs for c in self.clients.values())
         if total == 0:
             return True
         live_nodes = [
