@@ -206,13 +206,13 @@ def state_machine_status(machine) -> StateMachineStatus:
 def pretty(status: StateMachineStatus) -> str:
     """ASCII dashboard (reference: status/status.go:141-296)."""
     lines = [
-        f"===========================================",
+        "===========================================",
         f"NodeID={status.node_id}, "
         f"LowWatermark={status.low_watermark}, "
         f"HighWatermark={status.high_watermark}, "
         f"Epoch={status.epoch_tracker.number if status.epoch_tracker else '?'} "
         f"({status.epoch_tracker.state if status.epoch_tracker else '?'})",
-        f"===========================================",
+        "===========================================",
         "",
     ]
     if status.buckets:

@@ -5,7 +5,7 @@ import io
 
 from mirbft_tpu import pb
 from mirbft_tpu.cat import main, text
-from mirbft_tpu.eventlog import EngineLog, RecordedEvent, write_log
+from mirbft_tpu.eventlog import EngineLog, write_log
 from mirbft_tpu.testengine import BasicRecorder
 
 

@@ -2,8 +2,6 @@
 certs, ready gating, null-request fallback, fetch/rebroadcast ticks,
 checkpoint-boundary window advance, and window rebuild from CEntry pairs."""
 
-import pytest
-
 from mirbft_tpu import pb
 from mirbft_tpu.core.client_tracker import (
     ClientTracker,

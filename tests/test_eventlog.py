@@ -166,7 +166,6 @@ def test_torn_log_yields_intact_prefix(tmp_path):
     """A log whose writer died mid-stream (no gzip trailer / torn record)
     must still yield its intact prefix — the reader exists for exactly the
     runs that ended badly."""
-    import gzip
     import pytest
 
     path = str(tmp_path / "log.gz")

@@ -1,0 +1,46 @@
+"""Static-analysis gate (reference CI discipline: .travis.yml:16-18 runs
+staticcheck + the race detector; this repo's equivalent is tools/lint.py
+over every source tree — the suite fails on any finding)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def test_repo_is_lint_clean():
+    import lint
+
+    findings = lint.lint(
+        [
+            REPO / "mirbft_tpu",
+            REPO / "tests",
+            REPO / "tools",
+            REPO / "bench.py",
+            REPO / "__graft_entry__.py",
+        ]
+    )
+    assert not findings, "\n".join(findings)
+
+
+def test_linter_catches_the_defect_classes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"
+        "try:\n"
+        "    pass\n"
+        "except:\n"
+        "    pass\n"
+        "assert (1, 'always true')\n"
+        "x = 1\n"
+        "y = x is 'nope'\n"
+        "def f(a=[]):\n"
+        "    return a\n"
+        "z = f'no placeholders'\n"
+    )
+    import lint
+
+    findings = lint.lint([bad])
+    codes = {line.split()[1] for line in findings}
+    assert codes == {"W1", "W2", "W3", "W4", "W5", "W6"}, findings

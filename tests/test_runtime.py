@@ -10,7 +10,7 @@ import time
 import pytest
 
 from mirbft_tpu import pb
-from mirbft_tpu.core.preimage import host_digest, request_hash_data
+from mirbft_tpu.core.preimage import host_digest
 from mirbft_tpu.runtime import (
     Config,
     FileRequestStore,
@@ -19,7 +19,7 @@ from mirbft_tpu.runtime import (
     SerialProcessor,
     TpuProcessor,
 )
-from mirbft_tpu.runtime.node import standard_initial_network_state
+from mirbft_tpu.runtime.node import NodeStopped, standard_initial_network_state
 from mirbft_tpu.runtime.processor import Link, Log
 
 
@@ -51,8 +51,10 @@ class ThreadTransport:
                     return  # dropped: dest down
                 try:
                     node.step(source, msg)
-                except Exception:
-                    pass  # unreliable link semantics
+                except NodeStopped:
+                    pass  # dest halted concurrently: dropped, like a dead TCP
+                # Anything else (e.g. a validation crash) propagates — a bug
+                # must fail the run, not masquerade as an unreliable link.
 
         return _Link()
 
@@ -139,14 +141,14 @@ class Replica:
                 if results.digests or results.checkpoints:
                     try:
                         self.node.add_results(results)
-                    except Exception:
+                    except NodeStopped:
                         return
             now = time.monotonic()
             if now - last_tick >= self.tick_seconds:
                 last_tick = now
                 try:
                     self.node.tick()
-                except Exception:
+                except NodeStopped:
                     return
                 # Serve any state-transfer requests out of band.
                 # (Transfer actions are handled via actions.state_transfer.)

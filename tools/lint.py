@@ -1,0 +1,165 @@
+"""Stdlib-only static-analysis gate.
+
+The reference CI runs staticcheck + the race detector on every build
+(reference: .travis.yml:16-18).  This environment ships no third-party
+linter, so the equivalent discipline is a small AST-based checker that
+enforces the defect classes that have actually bitten BFT codebases:
+
+- W1 unused import            (dead seams hide refactor mistakes)
+- W2 bare ``except:``         (swallows KeyboardInterrupt/SystemExit)
+- W3 assert on a tuple literal (always true — a silently-disabled check)
+- W4 ``is``/``is not`` against str/int literals (identity vs equality)
+- W5 mutable default argument  (shared-state bug factory)
+- W6 f-string with no placeholders (usually a forgotten interpolation)
+
+Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
+Also enforced in CI-equivalent form by ``tests/test_lint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Collect imported names and every name usage per module."""
+
+    def __init__(self):
+        self.imports: dict[str, tuple[int, str]] = {}  # name -> (line, what)
+        self.used: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            # ``import x as x`` is the conventional re-export idiom: keep.
+            if alias.asname is not None and alias.asname == alias.name:
+                continue
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directive, not a binding
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            if alias.asname is not None and alias.asname == alias.name:
+                continue
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        self.generic_visit(node)
+
+
+def _string_uses(tree: ast.Module) -> set[str]:
+    """Names referenced from strings: __all__ entries and docstring-free
+    ``TYPE_CHECKING`` style annotations are the common cases."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            token = node.value.strip()
+            if token.isidentifier():
+                out.add(token)
+    return out
+
+
+def check_file(path: Path) -> list[str]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as err:
+        return [f"{path}:{err.lineno}: E0 syntax error: {err.msg}"]
+
+    findings: list[str] = []
+
+    tracker = _ImportTracker()
+    tracker.visit(tree)
+    stringy = _string_uses(tree)
+    is_package_init = path.name == "__init__.py"
+    for name, (line, what) in sorted(tracker.imports.items()):
+        if name in tracker.used or name in stringy:
+            continue
+        if is_package_init:
+            continue  # package __init__ imports are the public surface
+        findings.append(f"{path}:{line}: W1 unused import '{what}'")
+
+    # Format specs (the ``:6d`` in an f-string) are themselves JoinedStr
+    # nodes; they must not trip the W6 empty-f-string check.
+    spec_ids = {
+        id(n.format_spec)
+        for n in ast.walk(tree)
+        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
+    }
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(f"{path}:{node.lineno}: W2 bare 'except:'")
+        if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple):
+            if node.test.elts:
+                findings.append(
+                    f"{path}:{node.lineno}: W3 assert on tuple is always true"
+                )
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Is, ast.IsNot)) and isinstance(
+                    comp, ast.Constant
+                ) and isinstance(comp.value, (str, int, bytes)) and not isinstance(
+                    comp.value, bool
+                ):
+                    findings.append(
+                        f"{path}:{node.lineno}: W4 'is' comparison with literal"
+                    )
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        f"{path}:{default.lineno}: W5 mutable default argument"
+                    )
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids:
+            if not any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                findings.append(
+                    f"{path}:{node.lineno}: W6 f-string without placeholders"
+                )
+
+    return findings
+
+
+def lint(paths: list[Path]) -> list[str]:
+    findings: list[str] = []
+    for root in paths:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            findings.extend(check_file(f))
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    targets = (
+        [Path(a) for a in argv]
+        if argv
+        else [repo / "mirbft_tpu", repo / "tests", repo / "tools",
+              repo / "bench.py", repo / "__graft_entry__.py"]
+    )
+    findings = lint(targets)
+    for line in findings:
+        print(line)
+    print(f"lint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
