@@ -149,12 +149,20 @@ class Actions:
         self.state_transfer = None
 
     def concat(self, other: "Actions") -> "Actions":
-        self.sends.extend(other.sends)
-        self.hashes.extend(other.hashes)
-        self.write_ahead.extend(other.write_ahead)
-        self.commits.extend(other.commits)
-        self.store_requests.extend(other.store_requests)
-        self.forward_requests.extend(other.forward_requests)
+        # Truthiness guards: most concats carry nothing, and this runs on
+        # every event of every simulated node — skip the empty extends.
+        if other.sends:
+            self.sends.extend(other.sends)
+        if other.hashes:
+            self.hashes.extend(other.hashes)
+        if other.write_ahead:
+            self.write_ahead.extend(other.write_ahead)
+        if other.commits:
+            self.commits.extend(other.commits)
+        if other.store_requests:
+            self.store_requests.extend(other.store_requests)
+        if other.forward_requests:
+            self.forward_requests.extend(other.forward_requests)
         if other.state_transfer is not None:
             if self.state_transfer is not None:
                 raise AssertionError(

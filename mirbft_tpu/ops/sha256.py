@@ -131,6 +131,28 @@ def sha256_digest_words(blocks, n_blocks):
     return _sha256_blocks(blocks, n_blocks, max_blocks=blocks.shape[1])
 
 
+@functools.partial(jax.jit, static_argnames=("iters",))
+def sha256_chain_checksum(block, *, iters: int):
+    """Benchmark kernel: ``iters`` chained compressions over one (batch, 16)
+    block tensor, reduced to a scalar checksum.
+
+    Measuring device throughput through an RPC-tunneled backend is subtle:
+    ``block_until_ready`` may not actually wait, and repeated identical
+    launches can be served from a cache — so an honest timing needs (a) all
+    the work inside ONE launch with a sequential dependency chain, (b) a
+    scalar readback as the only sync, and (c) distinct inputs per call.
+    This helper provides (a)+(b); the caller supplies (c).
+    """
+    batch = block.shape[0]
+    state0 = jnp.broadcast_to(jnp.asarray(_IV), (batch, 8))
+
+    def body(state, _):
+        return _compress_batch(state, block), None
+
+    state, _ = jax.lax.scan(body, state0, None, length=iters)
+    return jnp.sum(state, dtype=jnp.uint32)
+
+
 def sha256_chunked(chunk_lists: list) -> list:
     """Digest a batch of chunked preimages (the Actions.hashes shape: each
     item is a list of byte chunks, digested over their concatenation).  The

@@ -1,0 +1,207 @@
+"""The coalescing crypto plane: cross-node digest batching for the engine.
+
+The reference executes hashes inline in each node's processor (reference:
+processor.go:133-143, testengine/recorder.go:445-455).  On an accelerator
+that wastes the device: each node's action batch alone is a handful of
+digests, far below the batch sizes that amortize a kernel launch.
+
+The engine gives us slack the reference never used: a hash result does not
+re-enter its state machine until ``ready_latency`` simulated milliseconds
+after the actions were executed.  Digests are pure functions of data known
+at schedule time, so the *computation* can be deferred until the first
+result event is actually delivered — and at that point every hash request
+accumulated across ALL nodes (typically everything scheduled at the same
+simulated instant) flushes as one batched kernel call.
+
+Determinism is untouched: the values are identical to inline execution, so
+event counts, recorded logs, and app hash chains come out bit-identical —
+the SURVEY §7 determinism-carries-over property, now with real cross-node
+coalescing (SURVEY §7 design stance: "coalesced across the action batch and
+across concurrently-processing nodes").
+"""
+
+from __future__ import annotations
+
+import time
+
+from .. import pb
+
+
+class _Lazy:
+    """Placeholder for a digest that has been submitted but not computed."""
+
+    __slots__ = ("plane", "index")
+
+    def __init__(self, plane: "CoalescingHashPlane", index: int):
+        self.plane = plane
+        self.index = index
+
+
+class CoalescingHashPlane:
+    """Deferred digest executor; install via ``Recorder(hash_plane=...)``.
+
+    ``digest_many`` maps a list of byte strings to their SHA-256 digests —
+    pass ``ops.sha256.sha256_many`` for the accelerator or leave None for
+    host hashlib (useful to isolate the coalescing itself in tests).
+    """
+
+    def __init__(self, digest_many=None):
+        if digest_many is None:
+            import hashlib
+
+            def digest_many(msgs):
+                return [hashlib.sha256(m).digest() for m in msgs]
+
+        self.digest_many = digest_many
+        self._pending: list[bytes] = []  # concatenated preimages
+        self._base = 0  # global index of _pending[0]
+        self._results: dict[int, bytes] = {}
+        # Telemetry for the bench: one entry per flush.
+        self.flush_sizes: list[int] = []
+        self.flush_wall_s: list[float] = []
+
+    # -- executor side (called from Recorder._execute) -----------------------
+
+    def submit(self, chunk_lists: list) -> list:
+        """Queue preimages; returns one placeholder per preimage."""
+        handles = []
+        for chunks in chunk_lists:
+            index = self._base + len(self._pending)
+            self._pending.append(b"".join(chunks))
+            handles.append(_Lazy(self, index))
+        return handles
+
+    # -- delivery side (called from Recorder.step) ---------------------------
+
+    def resolve_event(self, event: pb.StateEvent) -> None:
+        """Materialize any lazy digests in a results event, in place."""
+        if not isinstance(event.type, pb.EventActionResults):
+            return
+        for hr in event.type.digests:
+            if isinstance(hr.digest, _Lazy):
+                hr.digest = self._resolve(hr.digest.index)
+
+    def _resolve(self, index: int) -> bytes:
+        digest = self._results.get(index)
+        if digest is None:
+            self._flush()
+            digest = self._results[index]
+        return digest
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        start = time.perf_counter()
+        digests = self.digest_many(self._pending)
+        self.flush_wall_s.append(time.perf_counter() - start)
+        self.flush_sizes.append(len(self._pending))
+        for offset, digest in enumerate(digests):
+            self._results[self._base + offset] = digest
+        self._base += len(self._pending)
+        self._pending = []
+
+
+class AsyncKernelHashPlane(CoalescingHashPlane):
+    """The accelerator-backed plane, tuned for steady-state throughput.
+
+    Three refinements over the base class:
+
+    - **Proactive launching.**  Work is grouped by block bucket at submit
+      time, and a full chunk launches *immediately* — JAX's async dispatch
+      uploads and computes it while the engine keeps processing events, so
+      device work overlaps the Python protocol work (the work-pool slack of
+      processor.go:183-470 realized as dispatch pipelining).
+    - **Fixed launch shapes.**  Each bucket has one chunk row count (sized
+      so a launch carries ~``chunk_bytes`` of real data; tails pad up), so
+      only one batch shape per block bucket ever reaches the compiler — no
+      recompilation storms mid-run (SURVEY §7 hard part 3).
+    - **Lazy forcing.**  A chunk's device→host readback happens the first
+      time one of its digests is actually needed.
+
+    ``flush_wall_s`` records the blocking time the consumer actually
+    experiences per chunk (launch + forced-wait) — the honest
+    Actions→Results round-trip latency at the seam.
+    """
+
+    def __init__(self, chunk_rows: int = 8192, chunk_bytes: int = 1 << 21):
+        super().__init__(digest_many=None)
+        self.max_chunk_rows = chunk_rows
+        self.chunk_bytes = chunk_bytes
+        # block bucket -> [(global index, padded words ndarray)]
+        self._buckets: dict[int, list] = {}
+        # chunk id -> (device words array, [global indices], launch wall s)
+        self._inflight: dict[int, tuple] = {}
+        self._chunk_of: dict[int, int] = {}  # global index -> chunk id
+        self._next_chunk = 0
+
+    def rows_for(self, bucket: int) -> int:
+        """Chunk row count for a block bucket: ~chunk_bytes per launch,
+        clamped to [256, max_chunk_rows], power of two."""
+        rows = self.chunk_bytes // (bucket * 64)
+        rows = 1 << max(8, rows.bit_length() - 1)  # floor pow2, min 256
+        return min(self.max_chunk_rows, rows)
+
+    def submit(self, chunk_lists: list) -> list:
+        from ..ops.batching import next_pow2, sha256_pad
+
+        handles = []
+        for chunks in chunk_lists:
+            msg = b"".join(chunks)
+            index = self._base
+            self._base += 1
+            bucket = next_pow2(len(sha256_pad(msg)) // 64)
+            group = self._buckets.setdefault(bucket, [])
+            group.append((index, msg))
+            if len(group) >= self.rows_for(bucket):
+                self._launch(bucket, group)
+                self._buckets[bucket] = []
+            handles.append(_Lazy(self, index))
+        return handles
+
+    def _launch(self, bucket: int, group: list) -> None:
+        import jax
+
+        from ..ops.batching import pack_preimages
+        from ..ops.sha256 import sha256_digest_words
+
+        rows = self.rows_for(bucket)
+        start = time.perf_counter()
+        packed = pack_preimages(
+            [msg for _i, msg in group], block_floor=bucket, batch_floor=rows
+        )
+        words = sha256_digest_words(
+            jax.device_put(packed.blocks), jax.device_put(packed.n_blocks)
+        )
+        launch_s = time.perf_counter() - start
+        indices = [i for i, _msg in group]
+        cid = self._next_chunk
+        self._next_chunk += 1
+        self._inflight[cid] = (words, indices, launch_s)
+        for i in indices:
+            self._chunk_of[i] = cid
+        self.flush_sizes.append(len(indices))
+
+    def _flush(self) -> None:
+        """Launch every partially-filled bucket (called on a resolve miss)."""
+        for bucket, group in self._buckets.items():
+            if group:
+                self._launch(bucket, group)
+                self._buckets[bucket] = []
+
+    def _resolve(self, index: int) -> bytes:
+        digest = self._results.get(index)
+        if digest is not None:
+            return digest
+        if index not in self._chunk_of:
+            self._flush()
+        cid = self._chunk_of[index]
+        words, indices, launch_s = self._inflight.pop(cid)
+        start = time.perf_counter()
+        import numpy as np
+
+        raw = np.asarray(words).astype(">u4").tobytes()
+        self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+        for row, i in enumerate(indices):
+            self._results[i] = raw[32 * row : 32 * row + 32]
+            del self._chunk_of[i]
+        return self._results[index]

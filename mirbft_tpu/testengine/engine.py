@@ -88,15 +88,28 @@ class NodeState:
     pending_reconfigs: list = field(default_factory=list)
 
 
-@dataclass
 class _ClientState:
-    client_id: int
-    next_req_no: int = 0
-    total_reqs: int = 0
-    # node -> set of this client's req_nos seen committed there
-    committed_by_node: dict = field(default_factory=dict)
-    # req_nos committed anywhere (drives window refill exactly once)
-    committed_anywhere: set = field(default_factory=set)
+    def __init__(self, client_id: int, total_reqs: int = 0, owner=None):
+        self.client_id = client_id
+        self.next_req_no = 0
+        self._owner = owner  # Recorder, for total-reqs cache invalidation
+        self._total_reqs = total_reqs
+        # node -> set of this client's req_nos seen committed there
+        self.committed_by_node: dict = {}
+        # req_nos committed anywhere (drives window refill exactly once)
+        self.committed_anywhere: set = set()
+
+    @property
+    def total_reqs(self) -> int:
+        return self._total_reqs
+
+    @total_reqs.setter
+    def total_reqs(self, value: int) -> None:
+        # Direct assignment must invalidate the Recorder's cached total —
+        # tests legitimately shorten a removed client's stream this way.
+        self._total_reqs = value
+        if self._owner is not None:
+            self._owner._total_reqs_cache = None
 
     def request(self, req_no: int) -> pb.Request:
         # Deterministic payload, distinct per (client, req_no).
@@ -118,6 +131,7 @@ class Recorder:
         interceptor=None,
         manglers=(),
         hash_executor=None,
+        hash_plane=None,
     ):
         self.params = params or RuntimeParameters()
         self.rng = random.Random(seed)
@@ -132,6 +146,11 @@ class Recorder:
         # counts and app chains must come out identical (determinism carries
         # over the Actions seam, SURVEY §7).
         self.hash_executor = hash_executor
+        # Deferred cross-node digest batching (crypto_plane.py): digests are
+        # computed lazily at result-delivery time, coalescing everything
+        # pending across all nodes into one kernel call.  Mutually exclusive
+        # with hash_executor; values (and thus logs) are identical either way.
+        self.hash_plane = hash_plane
 
         client_ids = [node_count + i for i in range(client_count)]
         self.initial_state = standard_initial_network_state(
@@ -148,6 +167,13 @@ class Recorder:
         self.reconfig_on_commit: dict = {}
 
         self.event_count = 0
+        # Incremental mirror of per-node distinct-committed counts (the
+        # drain predicates run every step; recounting the per-client sets
+        # each time dominated large-run profiles).
+        self._committed_counts: dict[int, int] = dict.fromkeys(
+            range(node_count), 0
+        )
+        self._total_reqs_cache: int | None = None
         self.recorded_events: list = []  # [(time, node, pb.StateEvent)]
         self._queue: list = []  # heap of (time, seq, node, StateEvent)
         self._seq = 0
@@ -292,6 +318,10 @@ class Recorder:
             return True
 
         self.event_count += 1
+        if self.hash_plane is not None:
+            # Materialize lazy digests before the event is recorded or
+            # applied so logs match inline execution bit-for-bit.
+            self.hash_plane.resolve_event(event)
         if self.interceptor is not None:
             self.interceptor(node, self.now, event)
         self.recorded_events.append((self.now, node, event))
@@ -324,6 +354,7 @@ class Recorder:
                 mine = self.clients[cid].committed_by_node.setdefault(
                     node, set()
                 )
+                self._committed_counts[node] += len(req_nos - mine)
                 mine |= req_nos
             return
 
@@ -376,7 +407,11 @@ class Recorder:
 
         results = act.ActionResults()
         if actions.hashes:
-            if self.hash_executor is not None:
+            if self.hash_plane is not None:
+                digests = self.hash_plane.submit(
+                    [hr.data for hr in actions.hashes]
+                )
+            elif self.hash_executor is not None:
                 digests = self.hash_executor([hr.data for hr in actions.hashes])
             else:
                 digests = [host_digest(hr.data) for hr in actions.hashes]
@@ -426,8 +461,9 @@ class Recorder:
     def add_client(self, client_id: int, total_reqs: int) -> None:
         """Register a (reconfiguration-added) client and submit its initial
         request window to every node."""
-        client = _ClientState(client_id=client_id, total_reqs=total_reqs)
+        client = _ClientState(client_id, total_reqs=total_reqs, owner=self)
         self.clients[client_id] = client
+        self._total_reqs_cache = None
         for _ in range(min(total_reqs, 100)):
             self._submit_next_request(client, at_delay=0)
 
@@ -444,7 +480,10 @@ class Recorder:
             state.committed_reqs.append((ack.client_id, ack.req_no, batch.seq_no))
             client = self.clients.get(ack.client_id)
             if client is not None:
-                client.committed_by_node.setdefault(node, set()).add(ack.req_no)
+                seen = client.committed_by_node.setdefault(node, set())
+                if ack.req_no not in seen:
+                    seen.add(ack.req_no)
+                    self._committed_counts[node] += 1
                 if ack.req_no not in client.committed_anywhere:
                     # First commit anywhere slides the client's submission
                     # window (a deterministic stand-in for client waiters).
@@ -507,15 +546,29 @@ class Recorder:
 
     # -- assertions ----------------------------------------------------------
 
+    @property
+    def _total_reqs(self) -> int:
+        if self._total_reqs_cache is None:
+            self._total_reqs_cache = sum(
+                c.total_reqs for c in self.clients.values()
+            )
+        return self._total_reqs_cache
+
+    def set_client_total(self, client_id: int, total_reqs: int) -> None:
+        """Adjust how many requests a client will submit (e.g. a test
+        shortening a removed client's stream).  Equivalent to assigning
+        ``clients[cid].total_reqs`` — the setter invalidates the cache."""
+        self.clients[client_id].total_reqs = total_reqs
+
     def fully_committed(self) -> bool:
-        total = sum(c.total_reqs for c in self.clients.values())
+        total = self._total_reqs
         if total == 0:
             return True
-        live_nodes = [
-            n for n in range(self.node_count)
+        return all(
+            self._committed_counts[n] >= total
+            for n in range(self.node_count)
             if not self.node_states[n].crashed
-        ]
-        return all(self.committed_at(node) >= total for node in live_nodes)
+        )
 
     def drain_until(self, predicate, max_steps: int = 100_000) -> int:
         """Run until predicate(self) holds; returns events processed."""
@@ -534,10 +587,7 @@ class Recorder:
 
     def committed_at(self, node: int) -> int:
         """Distinct requests committed (or adopted via transfer) at node."""
-        return sum(
-            len(c.committed_by_node.get(node, ()))
-            for c in self.clients.values()
-        )
+        return self._committed_counts[node]
 
     def drain_clients(self, max_steps: int = 100_000) -> int:
         """Run until every client's requests commit at every live node;

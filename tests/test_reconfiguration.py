@@ -57,7 +57,7 @@ def test_remove_client_reconfiguration():
     r = BasicRecorder(node_count=4, client_count=2, reqs_per_client=30)
     second = sorted(r.clients)[1]
     # Shorten the second client's run so its requests finish early.
-    r.clients[second].total_reqs = 5
+    r.set_client_total(second, 5)
     r.reconfig_on_commit[(sorted(r.clients)[0], 25)] = [
         pb.Reconfiguration(type=pb.ReconfigRemoveClient(client_id=second))
     ]

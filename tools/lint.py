@@ -53,22 +53,24 @@ class _ImportTracker(ast.NodeVisitor):
         if isinstance(node.ctx, ast.Load):
             self.used.add(node.id)
 
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        self.generic_visit(node)
-
-    def visit_Expr(self, node: ast.Expr) -> None:
-        self.generic_visit(node)
-
 
 def _string_uses(tree: ast.Module) -> set[str]:
-    """Names referenced from strings: __all__ entries and docstring-free
-    ``TYPE_CHECKING`` style annotations are the common cases."""
+    """Names referenced from ``__all__`` string entries (the re-export
+    idiom).  Only those assignments count — treating any identifier-shaped
+    string anywhere as a use would let a stray dict key mask a genuinely
+    unused import."""
     out: set[str] = set()
     for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            token = node.value.strip()
-            if token.isidentifier():
-                out.add(token)
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        if not any(
+            isinstance(t, ast.Name) and t.id == "__all__" for t in targets
+        ):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.add(sub.value)
     return out
 
 
