@@ -109,19 +109,49 @@ def ladder_run(hash_plane=None):
 
 
 def warm_kernel_shapes(plane):
-    """Compile the launch shapes the ladder run uses (request/ack preimages
-    pad to the 1-block bucket; full BatchSize-200 batch preimages — 200
-    acks x 32B = 101 blocks — to the 128-block bucket, partially-filled
-    batches to the 64-block one) so the timed run measures steady state."""
+    """Compile every launch shape the ladder run can produce (request/ack
+    preimages pad to the 1-block bucket, full BatchSize-200 batch preimages
+    — 200 acks x 32B = 101 blocks — to the 128-block bucket, and partially
+    filled batches to any bucket between) so the timed run measures steady
+    state rather than XLA compile time."""
     import jax.numpy as jnp
 
     from mirbft_tpu.ops.sha256 import sha256_digest_words
 
-    for bucket in (1, 64, 128):
+    for bucket in (1, 2, 4, 8, 16, 32, 64, 128):
         rows = plane.rows_for(bucket)
         blocks = jnp.zeros((rows, bucket, 16), dtype=jnp.uint32)
         n = jnp.ones((rows,), dtype=jnp.int32)
         np.asarray(sha256_digest_words(blocks, n))
+
+
+def ed25519_microbench(batch: int = 1024):
+    """Batched signature verification (ladder rung 3): warm-shape kernel
+    rate vs the pure-Python host oracle (the only host verifier in this
+    environment — no libsodium), distinct signatures per call."""
+    from mirbft_tpu.crypto import ed25519_host as ed_host
+    from mirbft_tpu.ops.ed25519 import verify_batch
+
+    corpus = []
+    for i in range(batch):
+        seed = i.to_bytes(32, "little")
+        msg = b"bench-request-%d" % i
+        corpus.append((ed_host.public_key(seed), msg, ed_host.sign(seed, msg)))
+    pks, msgs, sigs = map(list, zip(*corpus))
+
+    verify_batch(pks[:batch], msgs, sigs)  # compile + warm the shape
+    flipped = [m + b"!" for m in msgs]  # distinct inputs for the timed call
+    start = time.perf_counter()
+    got = verify_batch(pks, flipped, sigs)
+    kernel_rate = batch / (time.perf_counter() - start)
+    assert not any(got)  # every flipped message must be rejected
+
+    sample = 64
+    start = time.perf_counter()
+    for pk, msg, sig in corpus[:sample]:
+        assert ed_host.verify(pk, msg, sig)
+    host_rate = sample / (time.perf_counter() - start)
+    return kernel_rate, host_rate
 
 
 def main():
@@ -138,6 +168,7 @@ def main():
     assert chain == host_chain, "kernel digests diverged from hashlib!"
 
     compressions_rate, kernel_digest_rate, host_rate = kernel_microbench()
+    ed_kernel_rate, ed_host_rate = ed25519_microbench()
 
     total_reqs = CLIENTS * REQS_PER_CLIENT
     committed_rate = total_reqs / tpu_wall
@@ -164,6 +195,10 @@ def main():
                 "kernel_compressions_per_sec": round(compressions_rate, 1),
                 "kernel_digests_per_sec_640B": round(kernel_digest_rate, 1),
                 "kernel_vs_hashlib": round(kernel_digest_rate / host_rate, 3),
+                "ed25519_verifies_per_sec": round(ed_kernel_rate, 1),
+                "ed25519_vs_host_python": round(
+                    ed_kernel_rate / ed_host_rate, 3
+                ),
             }
         )
     )

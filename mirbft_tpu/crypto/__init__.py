@@ -1,0 +1,7 @@
+"""Host-side cryptographic reference implementations.
+
+The accelerator kernels in ``ops/`` are bit-exactness-gated against these
+(the same discipline as ops.sha256 vs hashlib).
+"""
+
+from . import ed25519_host as ed25519_host

@@ -114,6 +114,8 @@ class _ClientState:
     def request(self, req_no: int) -> pb.Request:
         # Deterministic payload, distinct per (client, req_no).
         data = b"%d:%d" % (self.client_id, req_no)
+        if self._owner is not None and self._owner.signer is not None:
+            data = self._owner.signer(self.client_id, req_no, data)
         return pb.Request(client_id=self.client_id, req_no=req_no, data=data)
 
 
@@ -132,6 +134,8 @@ class Recorder:
         manglers=(),
         hash_executor=None,
         hash_plane=None,
+        signer=None,
+        signature_plane=None,
     ):
         self.params = params or RuntimeParameters()
         self.rng = random.Random(seed)
@@ -151,6 +155,13 @@ class Recorder:
         # pending across all nodes into one kernel call.  Mutually exclusive
         # with hash_executor; values (and thus logs) are identical either way.
         self.hash_plane = hash_plane
+        # Signed-request mode (signing.py): clients sign, and replicas
+        # authenticate each Propose at ingress — the consumer-side auth the
+        # reference mandates (mirbft.go:297-301) — via a deferred batched
+        # SignaturePlane.  Invalid requests are dropped before the state
+        # machine sees them.
+        self.signer = signer
+        self.signature_plane = signature_plane
 
         client_ids = [node_count + i for i in range(client_count)]
         self.initial_state = standard_initial_network_state(
@@ -294,6 +305,10 @@ class Recorder:
             return
         request = client.request(client.next_req_no)
         client.next_req_no += 1
+        if self.signature_plane is not None:
+            self.signature_plane.submit(
+                request.client_id, request.req_no, request.data
+            )
         for node in range(self.node_count):
             self._schedule(
                 at_delay + self.params.link_latency,
@@ -316,6 +331,16 @@ class Recorder:
         state = self.node_states[node]
         if state.crashed:
             return True
+        if self.signature_plane is not None and isinstance(
+            event.type, pb.EventPropose
+        ):
+            req = event.type.request
+            if not self.signature_plane.valid(
+                req.client_id, req.req_no, req.data
+            ):
+                # Ingress authentication failed: the replica never steps
+                # the state machine (unrecorded, like any dropped packet).
+                return True
 
         self.event_count += 1
         if self.hash_plane is not None:

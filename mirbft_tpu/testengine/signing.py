@@ -1,0 +1,162 @@
+"""Signed-request support for the testengine (BASELINE ladder rung 3).
+
+The reference explicitly leaves request authentication to the consumer —
+``Node.Step`` documents that the caller must have authenticated the source
+(reference: mirbft.go:297-301, docs/Design.md:18-21).  This module is that
+consumer-side ingress authentication, TPU-native: clients Ed25519-sign
+their requests, and replicas verify them in deferred batches through the
+same coalescing-plane pattern as digesting (crypto_plane.py).
+
+Request wire format in signed mode::
+
+    data = payload || signature(64) || public_key(32)
+
+The signed message binds client identity and sequence position:
+``b"%d:%d:" % (client_id, req_no) + payload`` — a replayed signature for a
+different (client, req_no) fails verification.
+"""
+
+from __future__ import annotations
+
+from ..crypto import ed25519_host as host
+
+SIG_LEN = 64
+PK_LEN = 32
+TRAILER = SIG_LEN + PK_LEN
+
+
+def client_seed(client_id: int) -> bytes:
+    """Deterministic per-client signing seed (test harness only)."""
+    return b"mirbft-tpu-client" + client_id.to_bytes(15, "big")
+
+
+def signing_message(client_id: int, req_no: int, payload: bytes) -> bytes:
+    return b"%d:%d:" % (client_id, req_no) + payload
+
+
+def make_signer():
+    """Returns signer(client_id, req_no, payload) -> signed request data.
+    Public keys are derived (and cached) from the deterministic seeds."""
+    pk_cache: dict[int, bytes] = {}
+
+    def signer(client_id: int, req_no: int, payload: bytes) -> bytes:
+        seed = client_seed(client_id)
+        pk = pk_cache.get(client_id)
+        if pk is None:
+            pk = pk_cache[client_id] = host.public_key(seed)
+        sig = host.sign(seed, signing_message(client_id, req_no, payload))
+        return payload + sig + pk
+
+    return signer
+
+
+def split_signed(data: bytes):
+    """data -> (payload, signature, public key); None if malformed."""
+    if len(data) < TRAILER:
+        return None
+    return data[:-TRAILER], data[-TRAILER:-PK_LEN], data[-PK_LEN:]
+
+
+# Expected-key registry, cached at module scope: derivation is a
+# milliseconds-long pure-Python scalar mult and the keys are deterministic
+# per client id, so re-deriving them on every SignaturePlane flush would
+# dominate signed-run time.
+_PK_CACHE: dict[int, bytes] = {}
+
+
+def _expected_pk(client_id: int, cache: dict = _PK_CACHE) -> bytes:
+    pk = cache.get(client_id)
+    if pk is None:
+        pk = cache[client_id] = host.public_key(client_seed(client_id))
+    return pk
+
+
+def host_verifier(items: list) -> list:
+    """items: [(client_id, req_no, data)] -> [bool], via the host oracle."""
+    cache = _PK_CACHE
+    out = []
+    for client_id, req_no, data in items:
+        parts = split_signed(data)
+        if parts is None:
+            out.append(False)
+            continue
+        payload, sig, pk = parts
+        out.append(
+            pk == _expected_pk(client_id, cache)
+            and host.verify(
+                pk, signing_message(client_id, req_no, payload), sig
+            )
+        )
+    return out
+
+
+def kernel_verifier(items: list) -> list:
+    """items: [(client_id, req_no, data)] -> [bool], signatures batched
+    onto the accelerator (ops.ed25519.verify_batch); the client-identity
+    binding (pk == registry pk) stays host-side."""
+    from ..ops.ed25519 import verify_batch
+
+    cache = _PK_CACHE
+    out = [False] * len(items)
+    pks, msgs, sigs, slots = [], [], [], []
+    for slot, (client_id, req_no, data) in enumerate(items):
+        parts = split_signed(data)
+        if parts is None:
+            continue
+        payload, sig, pk = parts
+        if pk != _expected_pk(client_id, cache):
+            continue
+        pks.append(pk)
+        msgs.append(signing_message(client_id, req_no, payload))
+        sigs.append(sig)
+        slots.append(slot)
+    if slots:
+        for slot, valid in zip(slots, verify_batch(pks, msgs, sigs)):
+            out[slot] = bool(valid)
+    return out
+
+
+class SignaturePlane:
+    """Deferred, coalesced request authentication.
+
+    Requests are submitted at schedule time (the client broadcast) and
+    judged at first delivery — at which point everything pending verifies
+    as one batch.  Verdicts are cached by (client_id, req_no, data), so
+    each distinct request is verified exactly once no matter how many
+    replicas receive it.  Deterministic: verdicts depend only on the data.
+    """
+
+    def __init__(self, verifier=host_verifier):
+        self.verifier = verifier
+        self._pending: list = []  # [(client_id, req_no, data)]
+        self._verdicts: dict = {}
+        self.flush_sizes: list[int] = []
+
+    def _key(self, client_id: int, req_no: int, data: bytes):
+        return (client_id, req_no, data)
+
+    def submit(self, client_id: int, req_no: int, data: bytes) -> None:
+        key = self._key(client_id, req_no, data)
+        if key not in self._verdicts:
+            self._pending.append((client_id, req_no, data))
+            self._verdicts[key] = None  # reserved: pending
+
+
+    def valid(self, client_id: int, req_no: int, data: bytes) -> bool:
+        key = self._key(client_id, req_no, data)
+        verdict = self._verdicts.get(key)
+        if verdict is None:
+            if key not in self._verdicts:
+                self._pending.append((client_id, req_no, data))
+            self._flush()
+            verdict = self._verdicts[key]
+        return verdict
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        batch = self._pending
+        self._pending = []
+        self.flush_sizes.append(len(batch))
+        for item, verdict in zip(batch, self.verifier(batch), strict=True):
+            self._verdicts[self._key(*item)] = verdict

@@ -1,0 +1,189 @@
+"""Ed25519: host oracle (RFC 8032), batched kernel, signed-request mode.
+
+BASELINE ladder rung 3 gates: the kernel's accept/reject must be
+bit-equivalent to the host oracle on valid, corrupted, and structurally
+invalid signatures, and a signed testengine run must authenticate every
+request at ingress — dropping forged ones — while still reaching full
+commitment with identical chains across nodes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mirbft_tpu.crypto import ed25519_host as host
+
+
+# -- host oracle ------------------------------------------------------------
+
+
+def test_rfc8032_vectors():
+    seed1 = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    assert host.public_key(seed1).hex() == (
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    assert host.sign(seed1, b"").hex() == (
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e0652249015"
+        "55fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    seed2 = bytes.fromhex(
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+    )
+    msg2 = bytes.fromhex("72")
+    assert host.sign(seed2, msg2).hex() == (
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69d"
+        "a085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+    )
+    assert host.verify(host.public_key(seed2), msg2, host.sign(seed2, msg2))
+
+
+def test_host_verify_rejects():
+    seed = b"\x05" * 32
+    pk, msg = host.public_key(seed), b"payload"
+    sig = host.sign(seed, msg)
+    assert host.verify(pk, msg, sig)
+    assert not host.verify(pk, msg + b"!", sig)
+    assert not host.verify(pk, msg, sig[:32] + sig[33:] + b"\x00")
+    flipped = sig[:5] + bytes([sig[5] ^ 1]) + sig[6:]
+    assert not host.verify(pk, msg, flipped)
+    other = host.public_key(b"\x06" * 32)
+    assert not host.verify(other, msg, sig)
+
+
+# -- field arithmetic -------------------------------------------------------
+
+
+def test_field_ops_exact_vs_bigints():
+    import jax.numpy as jnp
+
+    from mirbft_tpu.ops import ed25519 as k
+
+    rng = random.Random(0)
+    vals = [0, 1, 19, host.P - 1, host.P, host.P + 1, 2**255 - 1, 2**260 - 1]
+    vals += [rng.randrange(2**260) for _ in range(16)]
+    a_np = np.stack([k.int_to_limbs(v) for v in vals])
+    b_np = np.stack([k.int_to_limbs(v) for v in reversed(vals)])
+    a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+    m, s, d = k._mul(a, b), k._add(a, b), k._sub(a, b)
+    c = k._canonical(k._carry(a))
+    for i, (x, y) in enumerate(zip(vals, reversed(vals))):
+        assert k.limbs_to_int(m[i]) % host.P == (x * y) % host.P
+        assert k.limbs_to_int(s[i]) % host.P == (x + y) % host.P
+        assert k.limbs_to_int(d[i]) % host.P == (x - y) % host.P
+        assert k.limbs_to_int(c[i]) == x % host.P
+
+
+# -- batched kernel vs oracle ----------------------------------------------
+
+
+def _signed_corpus(n, rng):
+    pks, msgs, sigs, expect = [], [], [], []
+    for i in range(n):
+        seed = bytes(rng.randrange(256) for _ in range(32))
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 80)))
+        pk, sig = host.public_key(seed), host.sign(seed, msg)
+        kind = i % 4
+        if kind == 1:  # corrupted R
+            sig = bytes([sig[0] ^ 2]) + sig[1:]
+        elif kind == 2:  # corrupted S
+            sig = sig[:40] + bytes([sig[40] ^ 8]) + sig[41:]
+        elif kind == 3:  # wrong message
+            msg = msg + b"?"
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(sig)
+        expect.append(host.verify(pk, msg, sig))
+    return pks, msgs, sigs, expect
+
+
+def test_kernel_matches_oracle():
+    from mirbft_tpu.ops.ed25519 import verify_batch
+
+    rng = random.Random(42)
+    pks, msgs, sigs, expect = _signed_corpus(6, rng)
+    # Structural invalids: host-rejected, never reach the device.
+    pks += [b"\x00" * 31, host.public_key(b"\x01" * 32)]
+    msgs += [b"x", b"x"]
+    sigs += [b"\x00" * 64, b"\xff" * 64]  # bad pk len; S >= L
+    expect += [False, False]
+    got = verify_batch(pks, msgs, sigs)
+    assert got.tolist() == expect
+    assert any(expect) and not all(expect)  # corpus covers both outcomes
+
+
+# -- signed testengine runs -------------------------------------------------
+
+
+def _chains(recorder):
+    return {
+        n: recorder.node_states[n].app_chain.hex()
+        for n in range(recorder.node_count)
+        if not recorder.node_states[n].crashed
+    }
+
+
+def test_signed_run_host_verifier():
+    from mirbft_tpu import pb
+    from mirbft_tpu.testengine import BasicRecorder
+    from mirbft_tpu.testengine.signing import (
+        SignaturePlane,
+        host_verifier,
+        make_signer,
+    )
+
+    plane = SignaturePlane(verifier=host_verifier)
+    r = BasicRecorder(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=5,
+        signer=make_signer(),
+        signature_plane=plane,
+    )
+    # Inject a forged request: right shape, garbage signature.
+    forged = pb.Request(
+        client_id=4, req_no=99, data=b"evil" + b"\x01" * 96
+    )
+    for node in range(4):
+        r._schedule(
+            0, node, pb.StateEvent(type=pb.EventPropose(request=forged))
+        )
+    r.drain_clients(max_steps=200000)
+    assert len(set(_chains(r).values())) == 1
+    # Authentication actually ran, batched.
+    assert plane.flush_sizes and max(plane.flush_sizes) >= 4
+    # The forged request was dropped at ingress on every node: req_no 99
+    # never commits anywhere.
+    for state in r.node_states.values():
+        assert all(rn != 99 for (_c, rn, _s) in state.committed_reqs)
+
+
+@pytest.mark.slow
+def test_signed_run_kernel_verifier_identical():
+    """The kernel-authenticated run commits the same chains as the
+    host-authenticated one (determinism carries over the verify seam)."""
+    from mirbft_tpu.testengine import BasicRecorder
+    from mirbft_tpu.testengine.signing import (
+        SignaturePlane,
+        host_verifier,
+        kernel_verifier,
+        make_signer,
+    )
+
+    runs = {}
+    for name, verifier in (
+        ("host", host_verifier),
+        ("kernel", kernel_verifier),
+    ):
+        r = BasicRecorder(
+            node_count=4,
+            client_count=2,
+            reqs_per_client=4,
+            signer=make_signer(),
+            signature_plane=SignaturePlane(verifier=verifier),
+        )
+        count = r.drain_clients(max_steps=200000)
+        runs[name] = (count, tuple(sorted(_chains(r).values())))
+    assert runs["host"] == runs["kernel"]
