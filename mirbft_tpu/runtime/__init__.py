@@ -12,5 +12,10 @@ work per actions-batch into one kernel launch.
 from .config import Config  # noqa: F401
 from .log import ConsoleLogger, LogLevel  # noqa: F401
 from .node import ClientProposer, Node  # noqa: F401
-from .processor import SerialProcessor, TpuProcessor  # noqa: F401
+from .processor import (  # noqa: F401
+    PoolProcessor,
+    SerialProcessor,
+    TpuPoolProcessor,
+    TpuProcessor,
+)
 from .storage import FileRequestStore, FileWal  # noqa: F401

@@ -120,7 +120,84 @@ class SerialProcessor:
         return act.ActionResults(digests=digests, checkpoints=checkpoints)
 
 
-class TpuProcessor(SerialProcessor):
+class PoolProcessor(SerialProcessor):
+    """Parallel executor lanes with the persist→send safety barrier
+    (reference: ProcessorWorkPool, processor.go:183-470; barrier semantics
+    docs/Processor.md:22-28):
+
+        (persist → sends + forwards) ∥ hashes ∥ commits
+
+    All lanes are joined before the results return.  The invariant that
+    matters: nothing is *sent* until the WAL and request store are
+    durable, while hashing and committing float free of that barrier —
+    exactly the slack the reference's work pool exploits with goroutines,
+    here realized with a small thread pool (and, in TpuPoolProcessor, with
+    the accelerator absorbing the hash lane).
+
+    Unlike the reference, forwards run *after* this batch's persists (in
+    the transmit lane) rather than concurrently with them: a single
+    accumulated actions batch can contain both the store and a forward of
+    the same request, and reading the store before the persist lane wrote
+    it would silently drop the forward until a tick-driven retry.
+    """
+
+    def __init__(self, node, link: Link, app_log: Log, wal, request_store):
+        super().__init__(node, link, app_log, wal, request_store)
+        import concurrent.futures
+
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=3, thread_name_prefix=f"proc-{node.config.id}"
+        )
+
+    def _hash_lane(self, actions: act.Actions) -> list:
+        return self._hash(actions)
+
+    def _persist_transmit_lane(self, actions: act.Actions) -> None:
+        self._persist(actions)
+        self._transmit(actions)
+
+    def process(self, actions: act.Actions) -> act.ActionResults:
+        futures = [
+            self._pool.submit(self._persist_transmit_lane, actions),
+            self._pool.submit(self._hash_lane, actions),
+            self._pool.submit(self._commit, actions),
+        ]
+        # Join all lanes; propagate the first failure (a lane crash must
+        # fail the run, not vanish into a dropped future).
+        results = [f.result() for f in futures]
+        return act.ActionResults(digests=results[1], checkpoints=results[2])
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+
+class _DeviceHashMixin:
+    """The accelerator hash path shared by TpuProcessor/TpuPoolProcessor:
+    dispatch every hash request in the action batch as one bucketed kernel
+    call, collect the digests later (JAX async dispatch runs the kernel
+    while the host does other phases)."""
+
+    # Below this many hash requests the device round trip isn't worth it.
+    min_batch_for_device = 64
+
+    def _dispatch_device(self, hashes: list):
+        from ..ops.batching import pack_preimages
+        from ..ops.sha256 import sha256_digest_words
+
+        packed = pack_preimages([b"".join(hr.data) for hr in hashes])
+        return sha256_digest_words(packed.blocks, packed.n_blocks)
+
+    def _collect_device(self, hashes: list, words) -> list:
+        import numpy as np
+
+        raw = np.asarray(words).astype(">u4").tobytes()
+        return [
+            act.HashResult(digest=raw[32 * i : 32 * i + 32], request=hr)
+            for i, hr in enumerate(hashes)
+        ]
+
+
+class TpuProcessor(_DeviceHashMixin, SerialProcessor):
     """SerialProcessor with the hash phase dispatched to the accelerator.
 
     All hash requests in the batch launch as one bucketed kernel call; the
@@ -128,9 +205,6 @@ class TpuProcessor(SerialProcessor):
     while the host fsyncs, and the results are collected afterwards — the
     persist→send barrier is untouched because hashing feeds nothing but
     AddResults."""
-
-    # Below this many hash requests the device round trip isn't worth it.
-    min_batch_for_device = 64
 
     def process(self, actions: act.Actions) -> act.ActionResults:
         pending = None
@@ -148,18 +222,20 @@ class TpuProcessor(SerialProcessor):
         checkpoints = self._commit(actions)
         return act.ActionResults(digests=digests, checkpoints=checkpoints)
 
-    def _dispatch_device(self, hashes: list):
-        from ..ops.batching import pack_preimages
-        from ..ops.sha256 import sha256_digest_words
 
-        packed = pack_preimages([b"".join(hr.data) for hr in hashes])
-        return sha256_digest_words(packed.blocks, packed.n_blocks)
+class TpuPoolProcessor(_DeviceHashMixin, PoolProcessor):
+    """PoolProcessor with the accelerator absorbing the hash lane: the
+    kernel dispatch is issued on the calling thread before the lanes
+    launch, so the device computes while the persist/send/commit lanes
+    run; the hash lane then only collects the results."""
 
-    def _collect_device(self, hashes: list, words) -> list:
-        import numpy as np
+    def process(self, actions: act.Actions) -> act.ActionResults:
+        self._pending_device = None
+        if len(actions.hashes) >= self.min_batch_for_device:
+            self._pending_device = self._dispatch_device(actions.hashes)
+        return super().process(actions)
 
-        raw = np.asarray(words).astype(">u4").tobytes()
-        return [
-            act.HashResult(digest=raw[32 * i : 32 * i + 32], request=hr)
-            for i, hr in enumerate(hashes)
-        ]
+    def _hash_lane(self, actions: act.Actions) -> list:
+        if self._pending_device is not None:
+            return self._collect_device(actions.hashes, self._pending_device)
+        return self._hash(actions)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
 
 from .. import pb, wire
@@ -47,6 +48,9 @@ class FileWal:
         self._active = None  # open file handle for appends
         self._active_size = 0
         self._needs_sync = False
+        # Coarse mutex, like the reference simplewal's (simplewal.go:22-109):
+        # the pooled processor runs persist and commit lanes concurrently.
+        self._lock = threading.Lock()
 
     # -- load ----------------------------------------------------------------
 
@@ -96,6 +100,10 @@ class FileWal:
         self._active_size = self._active.tell()
 
     def write(self, index: int, entry: pb.Persistent) -> None:
+        with self._lock:
+            self._write_locked(index, entry)
+
+    def _write_locked(self, index: int, entry: pb.Persistent) -> None:
         if self._entries and index != self._entries[-1][0] + 1:
             raise CorruptWal(
                 f"non-contiguous append: {index} after {self._entries[-1][0]}"
@@ -115,6 +123,10 @@ class FileWal:
 
     def truncate(self, index: int) -> None:
         """Truncate-front: drop every entry with index < the given index."""
+        with self._lock:
+            self._truncate_locked(index)
+
+    def _truncate_locked(self, index: int) -> None:
         self._head_index = index
         with open(self._head_path + ".tmp", "wb") as f:
             f.write(str(index).encode())
@@ -132,16 +144,18 @@ class FileWal:
                 os.unlink(seg_path)
 
     def sync(self) -> None:
-        if self._active is not None and self._needs_sync:
-            self._active.flush()
-            os.fsync(self._active.fileno())
-            self._needs_sync = False
+        with self._lock:
+            if self._active is not None and self._needs_sync:
+                self._active.flush()
+                os.fsync(self._active.fileno())
+                self._needs_sync = False
 
     def close(self) -> None:
-        if self._active is not None:
-            self.sync()
-            self._active.close()
-            self._active = None
+        self.sync()
+        with self._lock:
+            if self._active is not None:
+                self._active.close()
+                self._active = None
 
 
 _REQ_HEADER = struct.Struct("<BII")  # op, ack_len, data_len
@@ -165,6 +179,10 @@ class FileRequestStore:
         self._replay()
         self._compact()
         self._file = open(self._log_path, "ab")
+        # store/commit run from different pooled lanes (reference reqstore
+        # wraps BadgerDB, which is internally synchronized; our file log
+        # needs the mutex).
+        self._lock = threading.Lock()
 
     @staticmethod
     def _key(ack: pb.RequestAck) -> bytes:
@@ -216,20 +234,24 @@ class FileRequestStore:
     # -- runtime interface ---------------------------------------------------
 
     def store(self, ack: pb.RequestAck, data: bytes) -> None:
-        self._write_record(self._file, _OP_STORE, ack, data or b"")
-        self._index[self._key(ack)] = (ack, data or b"")
+        with self._lock:
+            self._write_record(self._file, _OP_STORE, ack, data or b"")
+            self._index[self._key(ack)] = (ack, data or b"")
 
     def get(self, ack: pb.RequestAck) -> bytes | None:
-        entry = self._index.get(self._key(ack))
+        with self._lock:
+            entry = self._index.get(self._key(ack))
         return entry[1] if entry is not None else None
 
     def commit(self, ack: pb.RequestAck) -> None:
-        self._write_record(self._file, _OP_COMMIT, ack, b"")
-        self._index.pop(self._key(ack), None)
+        with self._lock:
+            self._write_record(self._file, _OP_COMMIT, ack, b"")
+            self._index.pop(self._key(ack), None)
 
     def sync(self) -> None:
-        self._file.flush()
-        os.fsync(self._file.fileno())
+        with self._lock:
+            self._file.flush()
+            os.fsync(self._file.fileno())
 
     def uncommitted(self, for_each) -> None:
         """Invoke for_each(ack) for every stored-but-uncommitted request, in

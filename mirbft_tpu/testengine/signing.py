@@ -146,8 +146,7 @@ class SignaturePlane:
         key = self._key(client_id, req_no, data)
         verdict = self._verdicts.get(key)
         if verdict is None:
-            if key not in self._verdicts:
-                self._pending.append((client_id, req_no, data))
+            self.submit(client_id, req_no, data)  # no-op if already pending
             self._flush()
             verdict = self._verdicts[key]
         return verdict

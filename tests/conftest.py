@@ -29,3 +29,10 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running scale tests")
+    # The axon TPU plugin IGNORES JAX_PLATFORMS=cpu (the default backend
+    # stays "tpu" and default-placed arrays go through the tunnel, whose
+    # latency weather makes kernel-path stress tests flaky).  Pin the
+    # default device to a real host CPU device explicitly.
+    import jax
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
