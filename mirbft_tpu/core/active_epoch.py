@@ -300,15 +300,23 @@ class ActiveEpoch:
 
     def drain_buffers(self) -> Actions:
         actions = Actions()
+        # Hot path: this runs once per event per bucket/node, and the
+        # buffers are nearly always empty — test MsgBuffer's public backing
+        # list to skip without a method call.
         for bucket in range(len(self.buckets)):
             pp_buffer = self.preprepare_buffers[bucket]
+            if not pp_buffer.buffer.msgs:
+                continue
             source = self.buckets[bucket]
             next_msg = pp_buffer.buffer.next(self.filter)
             if next_msg is not None:
                 # apply() loops consecutive preprepares internally.
                 actions.concat(self.apply(source, next_msg))
         for node in self.network_config.nodes:
-            self.other_buffers[node].iterate(
+            buffer = self.other_buffers[node]
+            if not buffer.msgs:
+                continue
+            buffer.iterate(
                 self.filter,
                 lambda src, msg: actions.concat(self.apply(src, msg)),
             )

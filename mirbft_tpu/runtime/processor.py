@@ -93,13 +93,20 @@ class SerialProcessor:
             for hr in actions.hashes
         ]
 
-    def _commit(self, actions: act.Actions) -> list:
+    def _commit(self, actions: act.Actions, defer_prune: list | None = None) -> list:
+        """Apply batches and snap checkpoints.  With ``defer_prune`` set,
+        committed acks are collected there instead of pruned from the
+        request store inline — the pooled processor prunes after its lanes
+        join so a same-batch forward can still read the data."""
         checkpoints = []
         for commit in actions.commits:
             if commit.batch is not None:
                 self.app_log.apply(commit.batch)
                 for ack in commit.batch.requests:
-                    self.request_store.commit(ack)
+                    if defer_prune is not None:
+                        defer_prune.append(ack)
+                    else:
+                        self.request_store.commit(ack)
             else:
                 value = self.app_log.snap(
                     commit.checkpoint.network_config,
@@ -157,14 +164,20 @@ class PoolProcessor(SerialProcessor):
         self._transmit(actions)
 
     def process(self, actions: act.Actions) -> act.ActionResults:
+        # Store prune is deferred past the lane join: the commit lane runs
+        # concurrently with the transmit lane, and pruning an ack that this
+        # same batch also forwards would make the forward read None.
+        pruned: list = []
         futures = [
             self._pool.submit(self._persist_transmit_lane, actions),
             self._pool.submit(self._hash_lane, actions),
-            self._pool.submit(self._commit, actions),
+            self._pool.submit(self._commit, actions, pruned),
         ]
         # Join all lanes; propagate the first failure (a lane crash must
         # fail the run, not vanish into a dropped future).
         results = [f.result() for f in futures]
+        for ack in pruned:
+            self.request_store.commit(ack)
         return act.ActionResults(digests=results[1], checkpoints=results[2])
 
     def close(self) -> None:

@@ -163,6 +163,54 @@ def test_sixteen_node_anchor():
     assert all(r.committed_at(n) == 16 * 100 for n in range(16))
 
 
+@pytest.mark.slow
+def test_sixty_four_node_network():
+    """64-node smoke at BASELINE rung-3 node count: full commitment with a
+    single chain and an exact-count determinism anchor."""
+    r = BasicRecorder(node_count=64, client_count=4, reqs_per_client=3,
+                      batch_size=10)
+    count = r.drain_clients(max_steps=2_000_000)
+    assert count == 1108608  # regression anchor for our engine
+    assert len(set(chains(r).values())) == 1
+    assert all(r.committed_at(n) == 12 for n in range(64))
+
+
+def test_epoch_change_storm():
+    """Consecutive forced epoch changes (the rung-4/5 storm ingredient):
+    silence a rotating leader in three back-to-back windows; the network
+    must climb through multiple epochs and still converge on one chain."""
+    from mirbft_tpu.testengine.manglers import (
+        after_time,
+        from_source,
+        is_step,
+        rule,
+        until_time,
+    )
+
+    manglers = [
+        rule(from_source(0), is_step(), until_time(8_000)).drop(),
+        rule(
+            from_source(1), is_step(), after_time(8_000), until_time(16_000)
+        ).drop(),
+        rule(
+            from_source(2), is_step(), after_time(16_000), until_time(24_000)
+        ).drop(),
+    ]
+    r = BasicRecorder(
+        node_count=4, client_count=2, reqs_per_client=8, manglers=manglers
+    )
+    r.drain_clients(max_steps=600000)
+    assert len(set(chains(r).values())) == 1
+
+    epochs = {
+        n: r.machines[n].epoch_tracker.current_epoch.number for n in range(4)
+    }
+    assert len(set(epochs.values())) == 1, epochs
+    # Three silenced-leader windows must have forced repeated epoch
+    # changes, not just one.
+    assert min(epochs.values()) >= 2, epochs
+
+
 def test_message_loss_mangler():
     """2% random message loss (reference scenario: mirbft_test.go:171-183):
     retransmission ticks must still drive the network to full commitment."""
