@@ -1,0 +1,263 @@
+"""Batched BLS12-381 G1 aggregation as a JAX kernel.
+
+BASELINE ladder rung 4: quorum-certificate aggregation — summing the 2f+1
+G1 signature points of each certificate — runs on the accelerator, one
+lax.scan of complete point additions over the voter axis, vmapped across
+a batch of certificates.  Pairing verification stays on the host
+(crypto/bls_host.py): it is O(1) per certificate and pointer-heavy.
+
+Field arithmetic: GF(p) for the 381-bit BLS prime as 30 limbs of 13 bits
+in int32.  p has no sparse structure (unlike 2^255-19), so reduction is
+**Montgomery REDC** with R = 2^390: each multiply is three 30x30 limb
+convolutions (a*b, T_lo*N', m*p), exact in int32 — a convolution sums at
+most 30 products of 26-bit values, staying under 2^31.  All elements are
+kept in [0, 2p): REDC output lands there, and add/sub conditionally
+subtract 2p.  Convolutions are expressed as one integer dot against a
+constant routing matrix (same trick as ops/ed25519.py).
+
+Point arithmetic: complete projective addition for y^2 = x^3 + b
+(Renes–Costello–Batina 2016, Algorithm 7, a = 0) — branch-free, handles
+identity and doubling, exactly what a masked scan wants.  b3 = 3*4 = 12
+is applied with add chains, which commute with the Montgomery form.
+
+Bit-exactness gate: tests/test_bls.py aggregates random signature sets on
+the device and compares against crypto.bls_host.aggregate_g1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bls_host as host
+
+NLIMB = 30
+RADIX = 13
+MASK = (1 << RADIX) - 1
+RBITS = NLIMB * RADIX  # 390
+R = 1 << RBITS
+P_INT = host.P
+P2_INT = 2 * host.P
+# N' = -p^(-1) mod R, the Montgomery constant.
+NPRIME_INT = (-pow(P_INT, -1, R)) % R
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    out = np.zeros(NLIMB, dtype=np.int32)
+    for i in range(NLIMB):
+        out[i] = x & MASK
+        x >>= RADIX
+    assert x == 0, "value out of range"
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    total = 0
+    for i, v in enumerate(np.asarray(limbs).tolist()):
+        total += int(v) << (RADIX * i)
+    return total
+
+
+_P_LIMBS = int_to_limbs(P_INT)
+_P2_LIMBS = int_to_limbs(P2_INT)
+_NPRIME_LIMBS = int_to_limbs(NPRIME_INT)
+
+# (900, 59) routing matrix: outer-product entry (i, j) -> column i + j.
+_CONV = np.zeros((NLIMB * NLIMB, 2 * NLIMB - 1), dtype=np.int32)
+for _i in range(NLIMB):
+    for _j in range(NLIMB):
+        _CONV[_i * NLIMB + _j, _i + _j] = 1
+
+
+def _conv(a, b):
+    """(batch, 30) x (batch, 30) -> (batch, 59) limb convolution (exact)."""
+    outer = (a[:, :, None] * b[:, None, :]).reshape(a.shape[0], NLIMB * NLIMB)
+    return jax.lax.dot_general(
+        outer,
+        jnp.asarray(_CONV),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def _carry(x, nlimb):
+    """One signed carry pass: limbs -> [0, 2^13), returns (limbs, carry_out).
+    Arithmetic shifts make this exact for negative values too."""
+    limbs = []
+    carry = jnp.zeros_like(x[:, 0])
+    for i in range(nlimb):
+        v = x[:, i] + carry
+        limbs.append(v & MASK)
+        carry = v >> RADIX
+    return jnp.stack(limbs, axis=1), carry
+
+
+def _mont_mul(a, b):
+    """Montgomery product abR^{-1} mod p; inputs/outputs in [0, 2p) with
+    carried (13-bit) limbs."""
+    t = _conv(a, b)  # 59 limbs, values < 30*2^26
+    t, t_top = _carry(t, 2 * NLIMB - 1)  # exact limbs + carry (limb 59)
+    t_lo = t[:, :NLIMB]
+    m = _conv(t_lo, jnp.broadcast_to(jnp.asarray(_NPRIME_LIMBS), t_lo.shape))
+    m, _ = _carry(m, 2 * NLIMB - 1)
+    m_lo = m[:, :NLIMB]  # m = T_lo * N' mod R
+    mp = _conv(m_lo, jnp.broadcast_to(jnp.asarray(_P_LIMBS), m_lo.shape))
+    # T + m*p: 60-limb sum; the low 30 limbs are divisible by R by
+    # construction, so after a carry pass they are exactly zero.
+    total = jnp.zeros((a.shape[0], 2 * NLIMB + 1), dtype=jnp.int32)
+    total = total.at[:, : 2 * NLIMB - 1].set(t)
+    total = total.at[:, 2 * NLIMB - 1].set(t_top)
+    total = total.at[:, : 2 * NLIMB - 1].add(mp)
+    total, top = _carry(total, 2 * NLIMB + 1)
+    out = total[:, NLIMB : 2 * NLIMB]  # the /R shift
+    out = out.at[:, NLIMB - 1].add(
+        (total[:, 2 * NLIMB] + (top << RADIX)) << RADIX
+    )
+    # t = (T + mp)/R < 2p < 2^382 fits 30 limbs; the add above folds the
+    # top two (always tiny) limbs back in, then one carry settles.
+    out, _ = _carry(out, NLIMB)
+    return out
+
+
+def _cond_sub_2p(x):
+    """x in [0, 4p) -> x mod 2p, branch-free."""
+    t = x - jnp.asarray(_P2_LIMBS)
+    t, borrow = _carry(t, NLIMB)
+    # borrow == -1 iff x < 2p.
+    keep = (borrow < 0)[:, None]
+    return jnp.where(keep, x, t)
+
+
+def _add(a, b):
+    s, _ = _carry(a + b, NLIMB)  # < 4p, no carry-out (fits 30 limbs)
+    return _cond_sub_2p(s)
+
+
+def _sub(a, b):
+    s, _ = _carry(a - b + jnp.asarray(_P2_LIMBS), NLIMB)  # in (0, 4p)
+    return _cond_sub_2p(s)
+
+
+def _mul_small(x, n: int):
+    """n*x for tiny n via add chains (stays in [0, 2p))."""
+    out = None
+    acc = x
+    while n:
+        if n & 1:
+            out = acc if out is None else _add(out, acc)
+        n >>= 1
+        if n:
+            acc = _add(acc, acc)
+    return out
+
+
+def _point_add(p1, p2):
+    """Complete projective addition on y^2 = x^3 + 4 (RCB16 Alg. 7, a=0,
+    b3=12); coordinates are Montgomery-form limb tensors."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    t0 = _mont_mul(x1, x2)
+    t1 = _mont_mul(y1, y2)
+    t2 = _mont_mul(z1, z2)
+    t3 = _mont_mul(_add(x1, y1), _add(x2, y2))
+    t3 = _sub(t3, _add(t0, t1))
+    t4 = _mont_mul(_add(y1, z1), _add(y2, z2))
+    t4 = _sub(t4, _add(t1, t2))
+    x3 = _mont_mul(_add(x1, z1), _add(x2, z2))
+    x3 = _sub(x3, _add(t0, t2))  # X1Z2 + X2Z1
+    t0 = _mul_small(t0, 3)
+    t2 = _mul_small(t2, 12)  # b3 * Z1Z2
+    z3 = _add(t1, t2)
+    t1 = _sub(t1, t2)
+    y3 = _mul_small(x3, 12)  # b3 * (X1Z2 + X2Z1)
+    x3 = _sub(_mont_mul(t3, t1), _mont_mul(t4, y3))
+    y3 = _add(_mont_mul(y3, t0), _mont_mul(t1, z3))
+    z3 = _add(_mont_mul(z3, t4), _mont_mul(t0, t3))
+    return (x3, y3, z3)
+
+
+@jax.jit
+def _aggregate(xs, ys, zs, mask):
+    """Masked sum over the voter axis.
+
+    xs/ys/zs: (batch, voters, 30) Montgomery-form projective coordinates;
+    mask: (batch, voters) int32 (0 drops the voter).
+    Returns (batch, 3, 30)."""
+    batch = xs.shape[0]
+    mont_one = jnp.broadcast_to(
+        jnp.asarray(int_to_limbs(R % P_INT)), (batch, NLIMB)
+    )
+    zero = jnp.zeros((batch, NLIMB), dtype=jnp.int32)
+    identity = (zero, mont_one, zero)
+
+    def step(acc, inputs):
+        x, y, z, live = inputs
+        keep = (live != 0)[:, None]
+        point = (
+            jnp.where(keep, x, identity[0]),
+            jnp.where(keep, y, identity[1]),
+            jnp.where(keep, z, identity[2]),
+        )
+        return _point_add(acc, point), None
+
+    acc, _ = jax.lax.scan(
+        step,
+        identity,
+        (
+            jnp.moveaxis(xs, 1, 0),
+            jnp.moveaxis(ys, 1, 0),
+            jnp.moveaxis(zs, 1, 0),
+            jnp.moveaxis(mask, 1, 0),
+        ),
+    )
+    return jnp.stack(acc, axis=1)
+
+
+def _to_mont(x: int) -> np.ndarray:
+    return int_to_limbs(x * R % P_INT)
+
+
+def _from_mont(limbs) -> int:
+    return limbs_to_int(limbs) * pow(R, -1, P_INT) % P_INT
+
+
+def aggregate_signatures(cert_sigs: list, voters: int | None = None):
+    """Aggregate a batch of quorum certificates on the device.
+
+    cert_sigs: list of certificates, each a list of affine G1 points
+    (or None for absent voters).  Returns a list of affine aggregate
+    points (or None), bit-equal to host aggregation.
+    """
+    if not cert_sigs:
+        return []
+    from .batching import next_pow2
+
+    # Power-of-two padding on both axes (absent-voter masking makes the
+    # padding rows free) so only a few launch shapes ever compile.
+    width = next_pow2(voters or max(len(c) for c in cert_sigs), floor=4)
+    batch = next_pow2(len(cert_sigs), floor=4)
+    xs = np.zeros((batch, width, NLIMB), dtype=np.int32)
+    ys = np.zeros((batch, width, NLIMB), dtype=np.int32)
+    zs = np.zeros((batch, width, NLIMB), dtype=np.int32)
+    mask = np.zeros((batch, width), dtype=np.int32)
+    for b, cert in enumerate(cert_sigs):
+        for v, point in enumerate(cert):
+            if point is None:
+                continue
+            xs[b, v] = _to_mont(point[0])
+            ys[b, v] = _to_mont(point[1])
+            zs[b, v] = _to_mont(1)
+            mask[b, v] = 1
+    out = np.asarray(_aggregate(xs, ys, zs, mask))
+    results = []
+    for b in range(len(cert_sigs)):
+        x = _from_mont(out[b, 0])
+        y = _from_mont(out[b, 1])
+        z = _from_mont(out[b, 2])
+        if z == 0:
+            results.append(None)
+            continue
+        zi = pow(z, P_INT - 2, P_INT)
+        results.append((x * zi % P_INT, y * zi % P_INT))
+    return results

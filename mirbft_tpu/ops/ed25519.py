@@ -167,14 +167,17 @@ def _feq(a, b):
     return jnp.all(_canonical(a) == _canonical(b), axis=1)
 
 
-@jax.jit
-def _ladder(s_bits, k_bits, neg_a, r_affine):
+def ladder_impl(s_bits, k_bits, neg_a, r_affine):
     """[S]B + [k](−A), compared projectively against R.
 
     s_bits, k_bits: (batch, 256) int32 in MSB-first order.
     neg_a: tuple of 4 (batch, 20) limb tensors (extended coords of −A).
     r_affine: (rx, ry) limb tensors (Z=1 from host decompression).
     Returns (batch,) bool.
+
+    Un-jitted implementation: parallel.sharding wraps it in shard_map to
+    run the batch data-parallel across a device mesh; verify_batch uses
+    the single-device jit below.
     """
     batch = s_bits.shape[0]
 
@@ -213,10 +216,61 @@ def _ladder(s_bits, k_bits, neg_a, r_affine):
     return ok_x & ok_y & nonzero
 
 
+_ladder = jax.jit(ladder_impl)
+
+
 def _bits_msb(x: int) -> np.ndarray:
     return np.array(
         [(x >> (255 - i)) & 1 for i in range(256)], dtype=np.int32
     )
+
+
+def marshal_signature(pk: bytes, message: bytes, signature: bytes):
+    """Host-side preparation of one signature for the device ladder:
+    structural validation, point decompression, and the SHA-512 challenge.
+    Returns (s_bits, k_bits, negA extended coords, R affine coords) or
+    None if the signature is structurally invalid (rejected on the host)."""
+    if len(pk) != 32 or len(signature) != 64:
+        return None
+    a = host.decompress(pk)
+    r = host.decompress(signature[:32])
+    if a is None or r is None:
+        return None
+    s = int.from_bytes(signature[32:], "little")
+    if s >= host.L:
+        return None
+    k = (
+        int.from_bytes(
+            hashlib.sha512(signature[:32] + pk + message).digest(), "little"
+        )
+        % host.L
+    )
+    neg_a = host.point_negate(a)
+    return (_bits_msb(s), _bits_msb(k), neg_a, (r[0], r[1]))
+
+
+def pack_rows(rows: list, batch_floor: int = 8):
+    """Stack marshalled rows into the ladder's input arrays, padding the
+    batch axis to a power-of-two bucket (so only a few launch shapes ever
+    compile) that is also a multiple of ``batch_floor`` — callers sharding
+    over an n-device mesh pass batch_floor=n.  Padding rows replicate row
+    0; their results must be discarded by the caller."""
+    from .batching import next_pow2
+
+    padded = next_pow2(len(rows), floor=batch_floor)
+    padded += (-padded) % batch_floor
+    rows_padded = rows + [rows[0]] * (padded - len(rows))
+    s_bits = np.stack([row[0] for row in rows_padded])
+    k_bits = np.stack([row[1] for row in rows_padded])
+    neg_a = tuple(
+        np.stack([int_to_limbs(row[2][c]) for row in rows_padded])
+        for c in range(4)
+    )
+    r_aff = tuple(
+        np.stack([int_to_limbs(row[3][c]) for row in rows_padded])
+        for c in range(2)
+    )
+    return s_bits, k_bits, neg_a, r_aff
 
 
 def verify_batch(pks: list, messages: list, signatures: list) -> np.ndarray:
@@ -229,56 +283,19 @@ def verify_batch(pks: list, messages: list, signatures: list) -> np.ndarray:
     n = len(pks)
     assert len(messages) == n and len(signatures) == n
     ok = np.zeros(n, dtype=bool)
-    rows = []  # (index, s_bits, k_bits, negA limbs, R limbs)
+    rows = []
+    indices = []
     for i, (pk, msg, sig) in enumerate(zip(pks, messages, signatures)):
-        if len(pk) != 32 or len(sig) != 64:
+        row = marshal_signature(pk, msg, sig)
+        if row is None:
             continue
-        a = host.decompress(pk)
-        r = host.decompress(sig[:32])
-        if a is None or r is None:
-            continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= host.L:
-            continue
-        k = (
-            int.from_bytes(
-                hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
-            )
-            % host.L
-        )
-        nax, nay, naz, nat = host.point_negate(a)
-        rows.append(
-            (
-                i,
-                _bits_msb(s),
-                _bits_msb(k),
-                (nax, nay, naz, nat),
-                (r[0], r[1]),
-            )
-        )
+        rows.append(row)
+        indices.append(i)
 
     if not rows:
         return ok
 
-    batch = len(rows)
-    # Pad the batch axis to a power-of-two bucket (min 8) so only a few
-    # launch shapes ever compile; padding rows replicate row 0 (their
-    # results are discarded).
-    from .batching import next_pow2
-
-    padded = next_pow2(batch, floor=8)
-    rows_padded = rows + [rows[0]] * (padded - batch)
-    s_bits = np.stack([row[1] for row in rows_padded])
-    k_bits = np.stack([row[2] for row in rows_padded])
-    neg_a = tuple(
-        np.stack([int_to_limbs(row[3][c]) for row in rows_padded])
-        for c in range(4)
-    )
-    r_aff = tuple(
-        np.stack([int_to_limbs(row[4][c]) for row in rows_padded])
-        for c in range(2)
-    )
-    valid = np.asarray(_ladder(s_bits, k_bits, neg_a, r_aff))
-    for row, v in zip(rows, valid[:batch]):
-        ok[row[0]] = bool(v)
+    valid = np.asarray(_ladder(*pack_rows(rows)))
+    for i, v in zip(indices, valid[: len(indices)]):
+        ok[i] = bool(v)
     return ok

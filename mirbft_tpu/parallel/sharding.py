@@ -1,6 +1,6 @@
 """Sharded crypto-plane kernels over a jax.sharding.Mesh.
 
-Two production paths:
+Three production paths:
 
 - ``sharded_sha256(mesh)``: the digest batch is sharded over the mesh's
   ``crypto`` axis (pure data parallelism — SHA-256 lanes are independent, so
@@ -8,6 +8,9 @@ Two production paths:
 - ``sharded_quorum_tally(mesh)``: vote matrices are sharded over voters; the
   per-sequence tally is a psum across the axis, i.e. the quorum check runs
   as an ICI collective instead of a host loop.
+- ``sharded_ed25519_verify(mesh)``: the signature batch data-parallel
+  across the mesh, each chip running the 256-step verification ladder on
+  its shard.
 
 Shardings are expressed with NamedSharding + explicit shard_map where the
 collective matters; everything compiles identically on a CPU-device mesh
@@ -114,5 +117,46 @@ def sharded_quorum_tally(mesh: Mesh):
             np.asarray(threshold, dtype=np.int32), replicated
         )
         return fn(votes, threshold)
+
+    return run
+
+
+def sharded_ed25519_verify(mesh: Mesh):
+    """Returns fn(s_bits, k_bits, neg_a, r_affine) -> (batch,) bool with the
+    signature batch sharded across the mesh (BASELINE rung 3 at pod scale:
+    each chip runs the 256-step Shamir ladder on its shard; verification is
+    embarrassingly parallel, so the only communication is the result
+    gather).  Batch must be a multiple of the mesh size — pack inputs with
+    ops.ed25519.pack_rows(rows, batch_floor=<mesh size>) to guarantee it
+    for any mesh."""
+    from ..ops.ed25519 import ladder_impl
+
+    point_spec = (P(AXIS, None),) * 4
+    fn = jax.jit(
+        jax.shard_map(
+            ladder_impl,
+            mesh=mesh,
+            in_specs=(
+                P(AXIS, None),
+                P(AXIS, None),
+                point_spec,
+                (P(AXIS, None),) * 2,
+            ),
+            out_specs=P(AXIS),
+            # The ladder mixes replicated curve constants into per-shard
+            # state; varying-manual-axes checking would demand pcasts for
+            # no semantic gain (same rationale as sharded_sha256).
+            check_vma=False,
+        )
+    )
+
+    row = NamedSharding(mesh, P(AXIS, None))
+
+    def run(s_bits, k_bits, neg_a, r_affine):
+        s_bits = jax.device_put(np.asarray(s_bits), row)
+        k_bits = jax.device_put(np.asarray(k_bits), row)
+        neg_a = tuple(jax.device_put(np.asarray(c), row) for c in neg_a)
+        r_affine = tuple(jax.device_put(np.asarray(c), row) for c in r_affine)
+        return fn(s_bits, k_bits, neg_a, r_affine)
 
     return run

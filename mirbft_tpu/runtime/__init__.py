@@ -19,3 +19,4 @@ from .processor import (  # noqa: F401
     TpuProcessor,
 )
 from .storage import FileRequestStore, FileWal  # noqa: F401
+from .transport import TcpTransport  # noqa: F401

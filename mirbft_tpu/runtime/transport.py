@@ -1,0 +1,173 @@
+"""TCP transport: the Link contract over real sockets (the DCN path).
+
+The reference deliberately ships no transport — the entire contract is
+``Link.Send(dest, msg)``, fire-and-forget and unreliable-by-assumption
+(reference: processor.go:23-25); the protocol tolerates loss via
+retransmit ticks.  This module is the consumer-side implementation for
+multi-host deployments: length-prefixed frames of the deterministic wire
+codec over persistent TCP connections between replica hosts, with the
+same drop-on-failure semantics the protocol already assumes.
+
+Authentication note: the reference makes source authentication the
+caller's job (mirbft.go:297-301).  Frames carry a claimed source id; a
+production deployment wraps the sockets in mutually-authenticated TLS and
+checks the claim against the peer certificate.  In-process and test use
+trust the header, exactly like the reference's test transports.
+
+Frame format: [u32 little-endian total length][varint source][pb.Msg].
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from .. import pb, wire
+from .processor import Link
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+class TcpTransport:
+    """One replica's endpoint: a listening socket delivering inbound
+    messages to the local Node, and lazily-connected outbound links."""
+
+    def __init__(self, node_id: int, host: str = "127.0.0.1", port: int = 0):
+        self.node_id = node_id
+        self._node = None
+        self._peers: dict[int, tuple] = {}  # id -> (host, port)
+        self._conns: dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+
+        self._server = socket.create_server((host, port))
+        self.address = self._server.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=f"tcp-accept-{node_id}",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    # -- wiring ----------------------------------------------------------------
+
+    def serve(self, node) -> None:
+        """Attach the local Node; inbound frames become node.step calls."""
+        self._node = node
+
+    def connect(self, peer_id: int, address: tuple) -> None:
+        """Register a peer's address; connections are opened lazily on the
+        first send and re-opened after failures."""
+        with self._lock:
+            self._peers[peer_id] = tuple(address)
+
+    # -- outbound --------------------------------------------------------------
+
+    def link(self) -> Link:
+        transport = self
+
+        class _TcpLink(Link):
+            def send(self, dest: int, msg: pb.Msg) -> None:
+                transport._send(dest, msg)
+
+        return _TcpLink()
+
+    def _send(self, dest: int, msg: pb.Msg) -> None:
+        payload = wire.encode_varint(self.node_id) + pb.encode(msg)
+        frame = _LEN.pack(len(payload)) + payload
+        with self._lock:
+            conn = self._conns.get(dest)
+            address = self._peers.get(dest)
+        if conn is None:
+            if address is None or self._closed.is_set():
+                return  # unknown peer: dropped, like any unreachable host
+            try:
+                conn = socket.create_connection(address, timeout=5)
+            except OSError:
+                return  # peer down: dropped; retransmit ticks recover
+            with self._lock:
+                existing = self._conns.setdefault(dest, conn)
+            if existing is not conn:
+                conn.close()
+                conn = existing
+        try:
+            with self._lock:
+                conn.sendall(frame)
+        except OSError:
+            with self._lock:
+                if self._conns.get(dest) is conn:
+                    del self._conns[dest]
+            conn.close()
+
+    # -- inbound ---------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _addr = self._server.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._read_loop,
+                args=(conn,),
+                name=f"tcp-read-{self.node_id}",
+                daemon=True,
+            ).start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                header = self._read_exact(conn, _LEN.size)
+                if header is None:
+                    return
+                (length,) = _LEN.unpack(header)
+                if length == 0 or length > _MAX_FRAME:
+                    return  # corrupt stream: drop the connection
+                payload = self._read_exact(conn, length)
+                if payload is None:
+                    return
+                self._deliver(payload)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _deliver(self, payload: bytes) -> None:
+        node = self._node
+        if node is None:
+            return  # not serving yet: dropped
+        try:
+            source, offset = wire.decode_varint(payload, 0)
+            msg = pb.decode(pb.Msg, payload[offset:])
+        except ValueError:
+            return  # malformed frame from a faulty peer: dropped
+        from .node import NodeStopped
+
+        try:
+            node.step(source, msg)
+        except (ValueError, NodeStopped):
+            return  # failed preflight validation / local shutdown: dropped
+
+    # -- shutdown --------------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed.set()
+        self._server.close()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            conn.close()

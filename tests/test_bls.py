@@ -1,0 +1,84 @@
+"""BLS12-381: host pairing oracle + device G1 quorum-cert aggregation.
+
+Rung-4 gates: bilinearity of the pairing, aggregate signature semantics
+(any missing or forged voter breaks the certificate), and the device
+aggregation kernel bit-equal to the host fold."""
+
+import pytest
+
+from mirbft_tpu.crypto import bls_host as bls
+
+
+def test_generators_and_orders():
+    assert bls.g1_on_curve(bls.G1)
+    assert bls.g2_on_curve(bls.G2)
+    assert bls.pt_mul(bls.FP, bls.R, bls.G1) is None
+    assert bls.pt_mul(bls.FP2, bls.R, bls.G2) is None
+
+
+@pytest.mark.slow
+def test_pairing_bilinearity():
+    e_base = bls.pairing(bls.G1, bls.G2)
+    e_2g1 = bls.pairing(bls.pt_mul(bls.FP, 2, bls.G1), bls.G2)
+    e_2g2 = bls.pairing(bls.G1, bls.pt_mul(bls.FP2, 2, bls.G2))
+    assert e_2g1 == bls.f12_mul(e_base, e_base)
+    assert e_2g1 == e_2g2
+    assert e_base != bls.F12_ONE  # non-degenerate
+
+
+@pytest.mark.slow
+def test_quorum_certificate_end_to_end():
+    """2f+1 of 4 replicas sign the same checkpoint statement; the
+    aggregate verifies, and any tampering breaks it."""
+    msg = b"checkpoint seq=40 value=ab12"
+    seeds = [bytes([i]) * 4 for i in range(4)]
+    pks = [bls.public_key(s) for s in seeds]
+    quorum = [0, 1, 3]  # 2f+1 = 3 of 4
+    sigs = [bls.sign(seeds[i], msg) for i in quorum]
+    asig = bls.aggregate_g1(sigs)
+    assert bls.verify_aggregate([pks[i] for i in quorum], msg, asig)
+    # Wrong statement.
+    assert not bls.verify_aggregate([pks[i] for i in quorum], msg + b"!", asig)
+    # Claimed quorum doesn't match the signers.
+    assert not bls.verify_aggregate([pks[i] for i in (0, 1, 2)], msg, asig)
+    # Dropped signature.
+    assert not bls.verify_aggregate(
+        [pks[i] for i in quorum], msg, bls.aggregate_g1(sigs[:2])
+    )
+
+
+@pytest.mark.slow
+def test_device_aggregation_matches_host():
+    from mirbft_tpu.ops.bls_g1 import aggregate_signatures
+
+    msg = b"batch digest"
+    certs, expected = [], []
+    for b in range(4):
+        seeds = [bytes([b, i]) for i in range(6)]
+        sigs = [bls.sign(s, msg) for s in seeds]
+        if b == 1:
+            sigs[2] = None  # absent voter mid-certificate
+        if b == 2:
+            sigs = sigs[:1]  # single-voter certificate
+        certs.append(sigs)
+        expected.append(
+            bls.aggregate_g1([s for s in sigs if s is not None])
+        )
+    assert aggregate_signatures(certs) == expected
+    # All-absent certificate aggregates to infinity.
+    assert aggregate_signatures([[None, None]]) == [None]
+
+
+@pytest.mark.slow
+def test_device_aggregate_verifies_as_quorum_cert():
+    """The full rung-4 flow: sign on 2f+1 replicas, aggregate on the
+    device, verify the certificate with one pairing equation on the host."""
+    from mirbft_tpu.ops.bls_g1 import aggregate_signatures
+
+    msg = b"epoch=3 seq=60 digest=77aa"
+    seeds = [bytes([i]) * 3 for i in range(4)]
+    sigs = [bls.sign(s, msg) for s in seeds]
+    pks = [bls.public_key(s) for s in seeds]
+    (asig,) = aggregate_signatures([sigs[:3]])
+    assert bls.verify_aggregate(pks[:3], msg, asig)
+    assert not bls.verify_aggregate(pks[:3], msg + b"x", asig)

@@ -46,6 +46,35 @@ def test_sharded_quorum_tally():
 
 
 @needs_8
+@pytest.mark.slow
+def test_sharded_ed25519_verify_matches_single_device():
+    """Signature verification sharded over the 8-device mesh: shard results
+    must equal the single-device ladder, accepting valid and rejecting
+    corrupted signatures."""
+    from mirbft_tpu.crypto import ed25519_host as host
+    from mirbft_tpu.ops import ed25519 as k
+    from mirbft_tpu.parallel.sharding import sharded_ed25519_verify
+
+    rows = []
+    for i in range(8):
+        seed = bytes([i]) * 32
+        msg = b"multichip-%d" % i
+        pk, sig = host.public_key(seed), host.sign(seed, msg)
+        if i % 2:
+            msg = msg + b"!"  # corrupt half of them
+        row = k.marshal_signature(pk, msg, sig)
+        assert row is not None
+        rows.append(row)
+    s_bits, k_bits, neg_a, r_aff = k.pack_rows(rows, batch_floor=8)
+
+    mesh = make_mesh(8)
+    sharded = sharded_ed25519_verify(mesh)
+    got = np.asarray(sharded(s_bits, k_bits, neg_a, r_aff))
+    single = np.asarray(k._ladder(s_bits, k_bits, neg_a, r_aff))
+    assert got.tolist() == single.tolist() == [i % 2 == 0 for i in range(8)]
+
+
+@needs_8
 def test_dryrun_multichip_entry_point():
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as graft

@@ -1,0 +1,178 @@
+"""TCP transport tier: real sockets between replicas (the DCN path).
+
+A 4-node network where every protocol message crosses a localhost TCP
+connection must still commit exactly once per node with agreeing chains —
+and a mid-run connection teardown must be absorbed as ordinary message
+loss (the protocol's retransmit ticks recover)."""
+
+import hashlib
+import queue
+import threading
+import time
+
+from mirbft_tpu import pb
+from mirbft_tpu.runtime import (
+    Config,
+    Node,
+    TcpTransport,
+)
+from mirbft_tpu.runtime.node import NodeStopped, standard_initial_network_state
+from mirbft_tpu.runtime.processor import Log, SerialProcessor
+
+
+class _ChainLog(Log):
+    def __init__(self):
+        self.chain = b""
+        self.commits = []
+        self.commit_events = queue.Queue()
+
+    def apply(self, q_entry):
+        for ack in q_entry.requests:
+            h = hashlib.sha256()
+            h.update(self.chain)
+            h.update(ack.digest)
+            self.chain = h.digest()
+            self.commits.append((ack.client_id, ack.req_no))
+            self.commit_events.put((ack.client_id, ack.req_no))
+
+    def snap(self, network_config, clients_state):
+        return self.chain
+
+
+class _MemWal:
+    def __init__(self):
+        self.entries = []
+
+    def write(self, index, entry):
+        self.entries.append((index, entry))
+
+    def truncate(self, index):
+        self.entries = [(i, e) for i, e in self.entries if i >= index]
+
+    def sync(self):
+        pass
+
+
+class _MemReqStore:
+    def __init__(self):
+        self.reqs = {}
+
+    def store(self, ack, data):
+        self.reqs[ack.digest] = data
+
+    def get(self, ack):
+        return self.reqs.get(ack.digest)
+
+    def commit(self, ack):
+        self.reqs.pop(ack.digest, None)
+
+    def sync(self):
+        pass
+
+
+class _TcpReplica:
+    def __init__(self, node_id, initial_state):
+        self.transport = TcpTransport(node_id)
+        self.node = Node.start_new(Config(id=node_id), initial_state)
+        self.transport.serve(self.node)
+        self.app_log = _ChainLog()
+        self.processor = SerialProcessor(
+            self.node,
+            self.transport.link(),
+            self.app_log,
+            _MemWal(),
+            _MemReqStore(),
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._consume, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _consume(self):
+        last_tick = time.monotonic()
+        while not self._stop.is_set():
+            actions = self.node.ready(timeout=0.01)
+            if actions is not None:
+                results = self.processor.process(actions)
+                if results.digests or results.checkpoints:
+                    try:
+                        self.node.add_results(results)
+                    except NodeStopped:
+                        return
+            if time.monotonic() - last_tick >= 0.05:
+                last_tick = time.monotonic()
+                try:
+                    self.node.tick()
+                except NodeStopped:
+                    return
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.node.stop()
+        self.transport.close()
+
+
+def test_four_node_consensus_over_tcp():
+    state = standard_initial_network_state(4, [9])
+    replicas = [_TcpReplica(i, state) for i in range(4)]
+    try:
+        # Full mesh: everyone knows everyone's listening address.
+        for a in replicas:
+            for b in replicas:
+                if a is not b:
+                    a.transport.connect(b.node.config.id, b.transport.address)
+        for replica in replicas:
+            replica.start()
+
+        requests = [
+            pb.Request(client_id=9, req_no=i, data=b"%d" % i)
+            for i in range(12)
+        ]
+        for request in requests[:6]:
+            for replica in replicas:
+                replica.node.propose(request)
+
+        # Mid-run teardown of one node's outbound connections: the frames
+        # in flight die with the sockets; retransmission must recover.
+        time.sleep(0.3)
+        with replicas[0].transport._lock:
+            conns = list(replicas[0].transport._conns.values())
+            replicas[0].transport._conns.clear()
+        for conn in conns:
+            conn.close()
+
+        for request in requests[6:]:
+            for replica in replicas:
+                replica.node.propose(request)
+
+        expected = {(9, r.req_no) for r in requests}
+        deadline = time.monotonic() + 120
+        for replica in replicas:
+            got = set()
+            while not expected <= got:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, (
+                    f"node {replica.node.config.id} timed out with "
+                    f"{len(got & expected)}/{len(expected)}; "
+                    f"exit={replica.node.exit_error!r}"
+                )
+                try:
+                    got.add(
+                        replica.app_log.commit_events.get(
+                            timeout=min(remaining, 1)
+                        )
+                    )
+                except queue.Empty:
+                    continue
+
+        for replica in replicas:
+            assert len(replica.app_log.commits) == len(
+                set(replica.app_log.commits)
+            ), "duplicate commit!"
+        assert len({r.app_log.chain for r in replicas}) == 1
+    finally:
+        for replica in replicas:
+            replica.stop()
+    assert all(r.node.exit_error is None for r in replicas)
