@@ -136,6 +136,8 @@ class Recorder:
         hash_plane=None,
         signer=None,
         signature_plane=None,
+        network_state=None,
+        checkpoint_certs=None,
     ):
         self.params = params or RuntimeParameters()
         self.rng = random.Random(seed)
@@ -162,11 +164,29 @@ class Recorder:
         # machine sees them.
         self.signer = signer
         self.signature_plane = signature_plane
+        # Checkpoint quorum certificates (certs.py): every Checkpoint
+        # broadcast doubles as a BLS vote; 2f+1 matching votes aggregate
+        # into one constant-size certificate.
+        self.checkpoint_certs = checkpoint_certs
 
-        client_ids = [node_count + i for i in range(client_count)]
-        self.initial_state = standard_initial_network_state(
-            node_count, client_ids
-        )
+        # Default protocol constants scale buckets/ci with the node count
+        # (reference: mirbft.go:125-154); very large networks pass an
+        # explicit network_state to tame the O(buckets * n^2) heartbeat
+        # traffic (fewer leaders, smaller checkpoint interval).  Client ids
+        # always come from the replicated state so the simulated clients
+        # and the protocol config agree by construction.
+        if network_state is not None:
+            self.initial_state = network_state
+            client_ids = [c.id for c in network_state.clients]
+            assert len(client_ids) == client_count, (
+                f"network_state declares {len(client_ids)} clients, "
+                f"client_count={client_count}"
+            )
+        else:
+            client_ids = [node_count + i for i in range(client_count)]
+            self.initial_state = standard_initial_network_state(
+                node_count, client_ids
+            )
         self.initial_checkpoint_value = b""
 
         self.clients = {}
@@ -404,6 +424,8 @@ class Recorder:
 
         send_delay = persist_delay + self.params.link_latency
         for send in actions.sends:
+            if self.checkpoint_certs is not None:
+                self.checkpoint_certs.observe(node, send.msg)
             for target in send.targets:
                 self._schedule(
                     send_delay,

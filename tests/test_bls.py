@@ -70,6 +70,38 @@ def test_device_aggregation_matches_host():
 
 
 @pytest.mark.slow
+def test_checkpoint_certs_from_consensus_run():
+    """Protocol integration: a 4-node testengine run produces BLS quorum
+    certificates for its stable checkpoints — votes collected from the
+    actual Checkpoint broadcasts, aggregated on the device, verified with
+    one pairing per certificate."""
+    from mirbft_tpu.testengine import BasicRecorder
+    from mirbft_tpu.testengine.certs import CheckpointCertPlane
+
+    plane = CheckpointCertPlane(quorum=3)  # 2f+1 at n=4, f=1
+    # 120 requests at batch 2 drive sequences well past several ci=20
+    # checkpoint boundaries.
+    r = BasicRecorder(
+        node_count=4, client_count=2, reqs_per_client=60, batch_size=2,
+        checkpoint_certs=plane,
+    )
+    r.drain_clients(max_steps=400000)
+    certs = plane.certificates()
+    assert certs, "no checkpoint reached a vote quorum"
+    # Every certificate verifies; a tampered statement does not.
+    (seq_no, value), (signers, asig) = next(iter(sorted(certs.items())))
+    assert len(signers) == 3
+    assert CheckpointCertPlane.verify(seq_no, value, signers, asig)
+    assert not CheckpointCertPlane.verify(seq_no + 1, value, signers, asig)
+    assert not CheckpointCertPlane.verify(
+        seq_no, value + b"x", signers, asig
+    )
+    # Certificates exist for multiple checkpoint windows: 120 requests at
+    # batch 2 drive sequences past several ci=20 boundaries.
+    assert len(certs) >= 2
+
+
+@pytest.mark.slow
 def test_device_aggregate_verifies_as_quorum_cert():
     """The full rung-4 flow: sign on 2f+1 replicas, aggregate on the
     device, verify the certificate with one pairing equation on the host."""

@@ -5,6 +5,8 @@ identical final app hash chains, reference: testengine/recorder_test.go):
 fixed seed ⇒ fixed event count ⇒ fixed app chain, identical on every node.
 """
 
+import os
+
 import pytest
 
 from mirbft_tpu import pb
@@ -173,6 +175,43 @@ def test_sixty_four_node_network():
     assert count == 1108608  # regression anchor for our engine
     assert len(set(chains(r).values())) == 1
     assert all(r.committed_at(n) == 12 for n in range(64))
+
+
+@pytest.mark.skipif(
+    not os.environ.get("MIRBFT_TPU_HEAVY"),
+    reason="~3 min: epoch change is O(n^3) messages at 128 nodes; "
+    "set MIRBFT_TPU_HEAVY=1 to run",
+)
+@pytest.mark.slow
+def test_one_hundred_twenty_eight_node_wan():
+    """BASELINE rung-4 node count under WAN jitter: 128 nodes, 4 leader
+    buckets (explicit network_state tames the O(buckets*n^2) heartbeat
+    traffic), 30ms jitter on every delivery.  The epoch-change ack scheme
+    alone is ~n^3 = 2M messages; measured ~4.4M events to full
+    commitment with one chain."""
+    from mirbft_tpu.testengine.manglers import is_step, rule
+
+    nodes = 128
+    clients = [nodes, nodes + 1]
+    state = pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(nodes)),
+            f=(nodes - 1) // 3,
+            number_of_buckets=4,
+            checkpoint_interval=20,
+            max_epoch_length=200,
+        ),
+        clients=[
+            pb.NetworkClient(id=c, width=100, low_watermark=0)
+            for c in clients
+        ],
+    )
+    r = BasicRecorder(
+        nodes, 2, 2, batch_size=10, network_state=state,
+        manglers=[rule(is_step()).jitter(30)],
+    )
+    r.drain_clients(max_steps=8_000_000)
+    assert len(set(chains(r).values())) == 1
 
 
 def test_epoch_change_storm():
