@@ -240,15 +240,78 @@ def _three(field: _Field):
     return field.add(field.add(field.one, field.one), field.one)
 
 
+def _jac_double(field: _Field, p):
+    """Jacobian doubling on y^2 = x^3 + b (a = 0; dbl-2009-l)."""
+    x, y, z = p
+    a = field.mul(x, x)
+    b = field.mul(y, y)
+    c = field.mul(b, b)
+    t = field.add(x, b)
+    d = field.sub(field.sub(field.mul(t, t), a), c)
+    d = field.add(d, d)
+    e = field.add(field.add(a, a), a)
+    f = field.mul(e, e)
+    x3 = field.sub(f, field.add(d, d))
+    c8 = field.add(c, c)
+    c8 = field.add(c8, c8)
+    c8 = field.add(c8, c8)
+    y3 = field.sub(field.mul(e, field.sub(d, x3)), c8)
+    z3 = field.mul(field.add(y, y), z)
+    return (x3, y3, z3)
+
+
+def _jac_add_affine(field: _Field, p, q):
+    """Jacobian p + affine q (madd-2007-bl); q must not be infinity."""
+    x1, y1, z1 = p
+    x2, y2 = q
+    z1z1 = field.mul(z1, z1)
+    u2 = field.mul(x2, z1z1)
+    s2 = field.mul(field.mul(y2, z1), z1z1)
+    if u2 == x1:
+        if s2 == y1:
+            return _jac_double(field, p)
+        return None  # p + (-p)
+    h = field.sub(u2, x1)
+    hh = field.mul(h, h)
+    i = field.add(field.add(hh, hh), field.add(hh, hh))
+    j = field.mul(h, i)
+    r = field.sub(s2, y1)
+    r = field.add(r, r)
+    v = field.mul(x1, i)
+    x3 = field.sub(field.sub(field.mul(r, r), j), field.add(v, v))
+    y1j = field.mul(y1, j)
+    y3 = field.sub(
+        field.mul(r, field.sub(v, x3)), field.add(y1j, y1j)
+    )
+    z3 = field.mul(field.add(z1, z1), h)
+    return (x3, y3, z3)
+
+
 def pt_mul(field: _Field, scalar: int, point):
-    out = None
-    addend = point
-    while scalar:
-        if scalar & 1:
-            out = pt_add(field, out, addend)
-        addend = pt_add(field, addend, addend)
-        scalar >>= 1
-    return out
+    """Double-and-add in Jacobian coordinates (one inversion at the end —
+    the affine group law pays a field inversion per addition, which makes
+    signing/keygen ~20x slower)."""
+    if point is None or scalar == 0:
+        return None
+    acc = None  # Jacobian accumulator; None is infinity
+    for i in range(scalar.bit_length() - 1, -1, -1):
+        if acc is not None:
+            acc = _jac_double(field, acc)
+        if (scalar >> i) & 1:
+            if acc is None:
+                acc = (point[0], point[1], field.one)
+            else:
+                acc = _jac_add_affine(field, acc, point)
+        if acc is not None and acc[2] == field.zero:
+            acc = None
+    if acc is None:
+        return None
+    zi = field.inv(acc[2])
+    zi2 = field.mul(zi, zi)
+    return (
+        field.mul(acc[0], zi2),
+        field.mul(acc[1], field.mul(zi2, zi)),
+    )
 
 
 def pt_neg(field: _Field, point):

@@ -182,6 +182,13 @@ class Recorder:
                 f"network_state declares {len(client_ids)} clients, "
                 f"client_count={client_count}"
             )
+            assert list(network_state.config.nodes) == list(
+                range(node_count)
+            ), (
+                f"network_state declares nodes "
+                f"{network_state.config.nodes}, engine simulates "
+                f"0..{node_count - 1}"
+            )
         else:
             client_ids = [node_count + i for i in range(client_count)]
             self.initial_state = standard_initial_network_state(
