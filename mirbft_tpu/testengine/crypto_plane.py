@@ -123,10 +123,21 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
     Actions→Results round-trip latency at the seam.
     """
 
-    def __init__(self, chunk_rows: int = 8192, chunk_bytes: int = 1 << 21):
+    def __init__(
+        self,
+        chunk_rows: int = 8192,
+        chunk_bytes: int = 1 << 21,
+        kernel_fn=None,
+    ):
         super().__init__(digest_many=None)
         self.max_chunk_rows = chunk_rows
         self.chunk_bytes = chunk_bytes
+        # Digest kernel: fn(blocks, n_blocks) -> (batch, 8) uint32 words.
+        # Default is the XLA scan kernel; pass
+        # ops.sha256_pallas.sha256_digest_words_pallas for the Pallas one.
+        if kernel_fn is None:
+            from ..ops.sha256 import sha256_digest_words as kernel_fn
+        self.kernel_fn = kernel_fn
         # block bucket -> [(global index, padded words ndarray)]
         self._buckets: dict[int, list] = {}
         # chunk id -> (device words array, [global indices], launch wall s)
@@ -162,14 +173,13 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         import jax
 
         from ..ops.batching import pack_preimages
-        from ..ops.sha256 import sha256_digest_words
 
         rows = self.rows_for(bucket)
         start = time.perf_counter()
         packed = pack_preimages(
             [msg for _i, msg in group], block_floor=bucket, batch_floor=rows
         )
-        words = sha256_digest_words(
+        words = self.kernel_fn(
             jax.device_put(packed.blocks), jax.device_put(packed.n_blocks)
         )
         launch_s = time.perf_counter() - start

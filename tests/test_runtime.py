@@ -138,6 +138,9 @@ class Replica:
                         pb.NetworkState(
                             config=cr.checkpoint.network_config,
                             clients=cr.checkpoint.clients_state,
+                            pending_reconfigurations=list(
+                                cr.reconfigurations
+                            ),
                         ),
                     )
                 if results.digests or results.checkpoints:
