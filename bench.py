@@ -80,9 +80,12 @@ def kernel_microbench():
     pallas_rate = chained_rate(
         lambda block, iters: sha256_chain_checksum_pallas(block, iters=iters)
     )
-    # The Pallas digest path must agree with hashlib before its rate counts.
+    # The Pallas digest path must agree with hashlib before its rate
+    # counts.  batch_floor=1024 (one full VPU tile) matters: smaller
+    # batches take the sub-tile XLA fallback and the gate would
+    # silently validate the wrong kernel.
     sample = [rng.bytes(MSG_BYTES) for _ in range(64)]
-    packed = pack_preimages(sample)
+    packed = pack_preimages(sample, batch_floor=1024)
     words = np.asarray(
         sha256_digest_words_pallas(
             packed.blocks, packed.n_blocks, interpret=False

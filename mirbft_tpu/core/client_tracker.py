@@ -45,6 +45,10 @@ from .quorum import bit_is_set, intersection_quorum, make_bitmask, set_bit, some
 
 _NULL = b""  # digest key of the null request
 
+# Shared no-op result for hot paths; MUST never be mutated (callers only
+# ever concat it into their own Actions).
+_EMPTY_ACTIONS = Actions()
+
 _CORRECT_FETCH_TICKS = 4
 _FETCH_TIMEOUT_TICKS = 4
 _ACK_RESEND_TICKS = 20
@@ -301,7 +305,10 @@ class ClientReqNo:
 
     def tick(self) -> Actions:
         if self.committed is not None:
-            return Actions()
+            # Hot path: every live reqNo of every client ticks every tick;
+            # the shared empty saves ~1M allocations on ladder-scale runs.
+            # Callers only concat tick results (never mutate them).
+            return _EMPTY_ACTIONS
 
         actions = Actions()
 

@@ -129,20 +129,24 @@ class StateMachine:
         inner = event.type
         actions = Actions()
 
-        if isinstance(inner, pb.EventInitialize):
+        # Exact-type dispatch ordered by frequency (pb event classes have
+        # no subclasses; this chain runs once per event of every node).
+        inner_type = type(inner)
+
+        if inner_type is pb.EventInitialize:
             self._initialize(inner.initial_parms)
             return Actions()
-        if isinstance(inner, pb.EventLoadEntry):
+        if inner_type is pb.EventLoadEntry:
             if self._state is not _SMState.LOADING:
                 raise AssertionError("not loading")
             self.persisted.append_initial_load(inner.index, inner.data)
             return Actions()
-        if isinstance(inner, pb.EventLoadRequest):
+        if inner_type is pb.EventLoadRequest:
             self._loaded_reqs.append(inner.request_ack)
             return Actions()
-        if isinstance(inner, pb.EventCompleteInitialization):
+        if inner_type is pb.EventCompleteInitialization:
             actions = self._complete_initialization()
-        elif isinstance(inner, pb.EventActionsReceived):
+        elif inner_type is pb.EventActionsReceived:
             # No-op marker tying action results to the actions that caused
             # them in recorded logs.
             return Actions()
@@ -151,16 +155,16 @@ class StateMachine:
                 raise AssertionError(
                     f"cannot apply {type(inner).__name__} before initialization"
                 )
-            if isinstance(inner, pb.EventTick):
+            if inner_type is pb.EventStep:
+                actions.concat(self._step(inner.source, inner.msg))
+            elif inner_type is pb.EventTick:
                 actions.concat(self.client_tracker.tick())
                 actions.concat(self.epoch_tracker.tick())
-            elif isinstance(inner, pb.EventStep):
-                actions.concat(self._step(inner.source, inner.msg))
-            elif isinstance(inner, pb.EventPropose):
+            elif inner_type is pb.EventPropose:
                 actions.concat(self._propose(inner.request))
-            elif isinstance(inner, pb.EventActionResults):
+            elif inner_type is pb.EventActionResults:
                 actions.concat(self._process_results(inner))
-            elif isinstance(inner, pb.EventTransfer):
+            elif inner_type is pb.EventTransfer:
                 if not self.commit_state.transferring:
                     raise AssertionError(
                         "transfer event without a requested transfer"

@@ -299,6 +299,10 @@ class Recorder:
         if state is not None and state.crashed:
             return  # a down node loses its inbound traffic
         when = self.now + delay
+        if not self.manglers:  # hot path: most runs are fault-free
+            heapq.heappush(self._queue, (when, self._seq, node, event))
+            self._seq += 1
+            return
         # Mangler protocol: each mangler maps one candidate to None (drop),
         # a (when, node, event) tuple, or a list of tuples (duplication);
         # manglers fold left over the candidate set.
