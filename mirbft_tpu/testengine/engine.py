@@ -138,6 +138,7 @@ class Recorder:
         signature_plane=None,
         network_state=None,
         checkpoint_certs=None,
+        record=True,
     ):
         self.params = params or RuntimeParameters()
         self.rng = random.Random(seed)
@@ -212,6 +213,10 @@ class Recorder:
             range(node_count), 0
         )
         self._total_reqs_cache: int | None = None
+        # record=False skips the in-memory recorded_events list (an
+        # interceptor still sees every event) — pod-scale runs are tens of
+        # millions of events and the list dominates memory.
+        self.record = record
         self.recorded_events: list = []  # [(time, node, pb.StateEvent)]
         self._queue: list = []  # heap of (time, seq, node, StateEvent)
         self._seq = 0
@@ -380,7 +385,8 @@ class Recorder:
             self.hash_plane.resolve_event(event)
         if self.interceptor is not None:
             self.interceptor(node, self.now, event)
-        self.recorded_events.append((self.now, node, event))
+        if self.record:
+            self.recorded_events.append((self.now, node, event))
 
         if isinstance(event.type, pb.EventTick):
             self._schedule(self.params.tick_interval, node, _tick_event())

@@ -343,6 +343,44 @@ def test_tpu_processor_device_and_host_paths_agree():
     assert host_results[0].digest == host_digest(hashes[0].data)
 
 
+def test_pool_processor_under_preemption_storm(tmp_path):
+    """The closest Python gets to the reference's race-detector tier
+    (.travis.yml:17 runs the stress suite under -race): shrink the
+    interpreter's thread switch interval 1000x so every shared-state
+    window between the serializer, consumer, and pool lanes gets hit by
+    preemption, then require exactly-once commits and agreeing chains."""
+    import sys
+
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    try:
+        transport = ThreadTransport()
+        state = standard_initial_network_state(4, [7])
+        replicas = [
+            Replica(i, transport, tmp_path, initial_state=state,
+                    processor_cls=PoolProcessor)
+            for i in range(4)
+        ]
+        try:
+            requests = make_requests(7, 30)
+            for request in requests:
+                for replica in replicas:
+                    replica.node.propose(request)
+            await_commits(
+                replicas, {(7, r.req_no) for r in requests}, timeout=240
+            )
+            for replica in replicas:
+                commits = [(c, r) for c, r, _s in replica.app_log.commits]
+                assert len(commits) == len(set(commits)), "duplicate commit!"
+            assert len({r.app_log.chain for r in replicas}) == 1
+        finally:
+            for replica in replicas:
+                replica.stop()
+        assert all(r.node.exit_error is None for r in replicas)
+    finally:
+        sys.setswitchinterval(old_interval)
+
+
 def test_wal_restart_resumes(tmp_path):
     """Kill a 1-node network after commits; restart from the durable WAL
     and verify it continues from its checkpoint."""

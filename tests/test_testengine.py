@@ -214,6 +214,41 @@ def test_one_hundred_twenty_eight_node_wan():
     assert len(set(chains(r).values())) == 1
 
 
+@pytest.mark.skipif(
+    not os.environ.get("MIRBFT_TPU_HEAVY"),
+    reason="~25 min, ~17 GB: 256 nodes is ~34.5M events; set "
+    "MIRBFT_TPU_HEAVY=1 to run",
+)
+@pytest.mark.slow
+def test_two_hundred_fifty_six_node_wan():
+    """BASELINE rung-5 node count under WAN jitter.  Validated once at
+    full scale: 34,477,535 events in ~23 min, all 256 chains identical.
+    record=False keeps memory proportional to live state, not history."""
+    from mirbft_tpu.testengine.manglers import is_step, rule
+
+    nodes = 256
+    clients = [nodes, nodes + 1]
+    state = pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(nodes)),
+            f=(nodes - 1) // 3,
+            number_of_buckets=4,
+            checkpoint_interval=20,
+            max_epoch_length=200,
+        ),
+        clients=[
+            pb.NetworkClient(id=c, width=100, low_watermark=0)
+            for c in clients
+        ],
+    )
+    r = BasicRecorder(
+        nodes, 2, 2, batch_size=10, network_state=state, record=False,
+        manglers=[rule(is_step()).jitter(30)],
+    )
+    r.drain_clients(max_steps=60_000_000)
+    assert len(set(chains(r).values())) == 1
+
+
 def test_epoch_change_storm():
     """Consecutive forced epoch changes (the rung-4/5 storm ingredient):
     silence a rotating leader in three back-to-back windows; the network
