@@ -273,29 +273,43 @@ def pack_rows(rows: list, batch_floor: int = 8):
     return s_bits, k_bits, neg_a, r_aff
 
 
-def verify_batch(pks: list, messages: list, signatures: list) -> np.ndarray:
+def verify_batch(
+    pks: list, messages: list, signatures: list, chunk: int = 512
+) -> np.ndarray:
     """Verify a batch of Ed25519 signatures; returns (n,) bool.
 
     Structural failures (bad lengths, non-canonical S, undecodable points)
-    are rejected on the host; everything else goes to the device in one
-    fixed-shape ladder launch.
+    are rejected on the host.  The rest launches in fixed-shape chunks
+    *as marshalling proceeds*: JAX async dispatch runs chunk N's ladder on
+    the device while the host decompresses/hashes chunk N+1, and results
+    are only forced at the end — host prep and device compute overlap
+    instead of serializing (each is roughly half the wall time).
     """
     n = len(pks)
     assert len(messages) == n and len(signatures) == n
     ok = np.zeros(n, dtype=bool)
-    rows = []
-    indices = []
+    pending = []  # (indices, in-flight device words)
+    rows: list = []
+    indices: list = []
+
+    def launch():
+        nonlocal rows, indices
+        if rows:
+            pending.append((indices, _ladder(*pack_rows(rows))))
+            rows, indices = [], []
+
     for i, (pk, msg, sig) in enumerate(zip(pks, messages, signatures)):
         row = marshal_signature(pk, msg, sig)
         if row is None:
             continue
         rows.append(row)
         indices.append(i)
+        if len(rows) == chunk:
+            launch()
+    launch()
 
-    if not rows:
-        return ok
-
-    valid = np.asarray(_ladder(*pack_rows(rows)))
-    for i, v in zip(indices, valid[: len(indices)]):
-        ok[i] = bool(v)
+    for idx, words in pending:
+        valid = np.asarray(words)
+        for i, v in zip(idx, valid[: len(idx)]):
+            ok[i] = bool(v)
     return ok

@@ -114,6 +114,29 @@ def test_kernel_matches_oracle():
     assert any(expect) and not all(expect)  # corpus covers both outcomes
 
 
+def test_kernel_chunked_pipeline_matches_oracle():
+    """Multi-chunk reassembly: chunk=3 forces several in-flight launches
+    with valid, device-rejected, and host-structural-rejected rows
+    straddling chunk boundaries; results must land on the right rows."""
+    from mirbft_tpu.ops.ed25519 import verify_batch
+
+    pks, msgs, sigs, expect = [], [], [], []
+    for i in range(11):
+        seed, msg = bytes([i]) * 32, b"p-%d" % i
+        pk, sig = host.public_key(seed), host.sign(seed, msg)
+        if i % 3 == 1:
+            msg += b"!"  # wrong message -> device reject
+        if i == 7:
+            sig = sig[:32] + b"\xff" * 32  # S >= L -> host reject
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(sig)
+        expect.append(host.verify(pk, msg, sig))
+    got = verify_batch(pks, msgs, sigs, chunk=3)
+    assert got.tolist() == expect
+    assert any(expect) and not all(expect)
+
+
 # -- signed testengine runs -------------------------------------------------
 
 
