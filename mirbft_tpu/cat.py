@@ -135,6 +135,34 @@ def cmd_status(events, args, out) -> None:
         print(status.pretty() if args.pretty else status.to_json(), file=out)
 
 
+def cmd_timing(events, out) -> None:
+    """Replay the log and report per-node state-machine execution time
+    (the reference CLI's per-node report, mircat/main.go:497-499)."""
+    import time as _time
+
+    player = Player(events)
+    wall: dict[int, float] = {}
+    applied: dict[int, int] = {}
+    while True:
+        start = _time.perf_counter()
+        recorded = player.step()
+        elapsed = _time.perf_counter() - start
+        if recorded is None:
+            break
+        node_id = recorded.node_id
+        wall[node_id] = wall.get(node_id, 0.0) + elapsed
+        applied[node_id] = applied.get(node_id, 0) + 1
+    for node_id in sorted(wall):
+        total_ms = 1e3 * wall[node_id]
+        per_event_us = 1e6 * wall[node_id] / applied[node_id]
+        print(
+            f"# node {node_id}: {applied[node_id]} events, "
+            f"{total_ms:.1f} ms state-machine time "
+            f"({per_event_us:.1f} us/event)",
+            file=out,
+        )
+
+
 def cmd_diff(path_a: str, path_b: str, out) -> int:
     events_a = read_log(path_a)
     events_b = read_log(path_b)
@@ -174,6 +202,9 @@ def main(argv=None, out=sys.stdout) -> int:
     parser.add_argument("--status-at", type=int, default=None,
                         help="replay to this index and print every node's status "
                              "(-1 = end of log)")
+    parser.add_argument("--timing", action="store_true",
+                        help="replay and report per-node state-machine "
+                             "execution time")
     parser.add_argument("--pretty", action="store_true",
                         help="ASCII status dashboard instead of JSON")
     parser.add_argument("--diff", nargs=2, metavar=("A", "B"),
@@ -188,6 +219,8 @@ def main(argv=None, out=sys.stdout) -> int:
     events = read_log(args.log)
     if args.summary:
         cmd_summary(events, out)
+    elif args.timing:
+        cmd_timing(events, out)
     elif args.status_at is not None:
         cmd_status(events, args, out)
     else:
@@ -196,4 +229,7 @@ def main(argv=None, out=sys.stdout) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)  # e.g. `... | head` closed the pipe: not an error

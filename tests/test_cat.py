@@ -91,3 +91,13 @@ def test_diff(tmp_path):
     out = io.StringIO()
     assert main(["--diff", path_a, path_c], out=out) == 1
     assert "first divergence at event 10" in out.getvalue()
+
+
+def test_timing_report(tmp_path):
+    path, events = _record_run(tmp_path)
+    out = io.StringIO()
+    assert main([path, "--timing"], out=out) == 0
+    report = out.getvalue()
+    for node in range(4):
+        assert f"# node {node}: " in report
+    assert "us/event" in report
