@@ -468,6 +468,11 @@ def verify_aggregate(pks, message: bytes, asig) -> bool:
     e(asig, G2) == e(H(m), apk)."""
     if asig is None or not g1_on_curve(asig):
         return False
+    # Subgroup check: an on-curve point with a cofactor component would be
+    # accepted by the pairing equation's bilinear structure; require
+    # r·asig = O so the signature is in the order-r subgroup.
+    if pt_mul(FP, R, asig) is not None:
+        return False
     apk = aggregate_g2(pks)
     if apk is None:
         return False

@@ -257,6 +257,11 @@ def pack_rows(rows: list, batch_floor: int = 8):
     0; their results must be discarded by the caller."""
     from .batching import next_pow2
 
+    if not rows:
+        raise ValueError(
+            "pack_rows needs at least one row; an all-structurally-invalid "
+            "batch has nothing to launch — skip the kernel call"
+        )
     padded = next_pow2(len(rows), floor=batch_floor)
     padded += (-padded) % batch_floor
     rows_padded = rows + [rows[0]] * (padded - len(rows))

@@ -167,17 +167,24 @@ class PoolProcessor(SerialProcessor):
         # Store prune is deferred past the lane join: the commit lane runs
         # concurrently with the transmit lane, and pruning an ack that this
         # same batch also forwards would make the forward read None.
+        import concurrent.futures
+
         pruned: list = []
         futures = [
             self._pool.submit(self._persist_transmit_lane, actions),
             self._pool.submit(self._hash_lane, actions),
             self._pool.submit(self._commit, actions, pruned),
         ]
-        # Join all lanes; propagate the first failure (a lane crash must
-        # fail the run, not vanish into a dropped future).
-        results = [f.result() for f in futures]
-        for ack in pruned:
-            self.request_store.commit(ack)
+        # Join ALL lanes before propagating any failure: raising while a
+        # sibling lane still mutates the WAL/store would hand the caller a
+        # half-written state.  Whatever the commit lane managed to commit
+        # is pruned even on the failure path, so acks don't leak.
+        concurrent.futures.wait(futures)
+        try:
+            results = [f.result() for f in futures]
+        finally:
+            for ack in pruned:
+                self.request_store.commit(ack)
         return act.ActionResults(digests=results[1], checkpoints=results[2])
 
     def close(self) -> None:

@@ -38,7 +38,10 @@ class TcpTransport:
         self.node_id = node_id
         self._node = None
         self._peers: dict[int, tuple] = {}  # id -> (host, port)
-        self._conns: dict[int, socket.socket] = {}
+        # id -> (socket, per-connection send lock).  The transport-wide
+        # _lock guards only the maps; sends serialize per peer so one
+        # stalled peer cannot block broadcast to the others.
+        self._conns: dict[int, tuple[socket.socket, threading.Lock]] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
 
@@ -78,26 +81,34 @@ class TcpTransport:
         payload = wire.encode_varint(self.node_id) + pb.encode(msg)
         frame = _LEN.pack(len(payload)) + payload
         with self._lock:
-            conn = self._conns.get(dest)
+            entry = self._conns.get(dest)
             address = self._peers.get(dest)
-        if conn is None:
+        if entry is None:
             if address is None or self._closed.is_set():
                 return  # unknown peer: dropped, like any unreachable host
             try:
                 conn = socket.create_connection(address, timeout=5)
             except OSError:
                 return  # peer down: dropped; retransmit ticks recover
+            entry = (conn, threading.Lock())
             with self._lock:
-                existing = self._conns.setdefault(dest, conn)
-            if existing is not conn:
+                # Re-check under the lock: close() may have swept _conns
+                # while create_connection blocked; inserting now would leak
+                # the socket past shutdown.
+                if self._closed.is_set():
+                    conn.close()
+                    return
+                existing = self._conns.setdefault(dest, entry)
+            if existing is not entry:
                 conn.close()
-                conn = existing
+                entry = existing
+        conn, send_lock = entry
         try:
-            with self._lock:
+            with send_lock:
                 conn.sendall(frame)
         except OSError:
             with self._lock:
-                if self._conns.get(dest) is conn:
+                if self._conns.get(dest) is entry:
                     del self._conns[dest]
             conn.close()
 
@@ -167,7 +178,7 @@ class TcpTransport:
         self._closed.set()
         self._server.close()
         with self._lock:
-            conns = list(self._conns.values())
+            conns = [conn for conn, _lock in self._conns.values()]
             self._conns.clear()
         for conn in conns:
             conn.close()

@@ -176,7 +176,7 @@ def test_four_node_consensus_over_tcp():
         # in flight die with the sockets; retransmission must recover.
         time.sleep(0.3)
         with replicas[0].transport._lock:
-            conns = list(replicas[0].transport._conns.values())
+            conns = [c for c, _lock in replicas[0].transport._conns.values()]
             replicas[0].transport._conns.clear()
         for conn in conns:
             conn.close()
