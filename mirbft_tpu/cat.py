@@ -63,11 +63,15 @@ def event_kind(event: pb.StateEvent) -> str:
     return type(event.type).__name__
 
 
-def msg_kind(event: pb.StateEvent) -> str | None:
+def msg_kinds(event: pb.StateEvent) -> set:
+    """Wire-message kinds carried by the event (a coalesced EventStepBatch
+    can carry several; a --msg-type filter matches if any inner msg does)."""
     inner = event.type
     if isinstance(inner, pb.EventStep) and inner.msg is not None:
-        return type(inner.msg.type).__name__
-    return None
+        return {type(inner.msg.type).__name__}
+    if isinstance(inner, pb.EventStepBatch):
+        return {type(m.type).__name__ for m in inner.msgs}
+    return set()
 
 
 # ---------------------------------------------------------------------------
@@ -86,8 +90,8 @@ def filter_events(events, args):
         if args.event_type and event_kind(recorded.state_event) not in args.event_type:
             continue
         if args.msg_type:
-            kind = msg_kind(recorded.state_event)
-            if kind is None or kind not in args.msg_type:
+            kinds = msg_kinds(recorded.state_event)
+            if not kinds or kinds.isdisjoint(args.msg_type):
                 continue
         yield index, recorded
 

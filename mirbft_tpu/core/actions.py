@@ -21,13 +21,13 @@ from dataclasses import dataclass, field
 from .. import pb
 
 
-@dataclass
+@dataclass(slots=True)
 class WalAppend:
     index: int
     data: pb.Persistent
 
 
-@dataclass
+@dataclass(slots=True)
 class WalWrite:
     """Exactly one of truncate/append is set (reference: actions.go:128-137).
     ``truncate`` removes every entry with index below the given value."""
@@ -36,13 +36,17 @@ class WalWrite:
     append: WalAppend | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class Send:
+    """``targets`` is stored by reference (callers pass the shared
+    network-config node list or a fresh sorted list) and must not be
+    mutated after the Send is emitted."""
+
     targets: list  # node IDs, including self
     msg: pb.Msg
 
 
-@dataclass
+@dataclass(slots=True)
 class Forward:
     """Like Send, but the executor must first fetch the request data from its
     request store and wrap it in a ForwardRequest message."""
@@ -51,7 +55,7 @@ class Forward:
     request_ack: pb.RequestAck
 
 
-@dataclass
+@dataclass(slots=True)
 class HashRequest:
     """A digest the executor must compute: SHA-256 over the concatenation of
     ``data`` chunks (layouts in core.preimage).  ``origin`` is a pb.HashResult
@@ -62,7 +66,7 @@ class HashRequest:
     origin: pb.HashResult
 
 
-@dataclass
+@dataclass(slots=True)
 class CheckpointReq:
     """A request for the application to compute a checkpoint value over its
     state at seq_no (reference: actions.go:181-205).  The value must be a
@@ -75,7 +79,7 @@ class CheckpointReq:
     clients_state: list  # [pb.NetworkClient]
 
 
-@dataclass
+@dataclass(slots=True)
 class CommitAction:
     """Either a totally-ordered batch to apply, or a checkpoint request.
     Exactly one is set."""
@@ -84,13 +88,13 @@ class CommitAction:
     checkpoint: CheckpointReq | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class StateTarget:
     seq_no: int
     value: bytes
 
 
-@dataclass
+@dataclass(slots=True)
 class Actions:
     sends: list = field(default_factory=list)  # [Send]
     hashes: list = field(default_factory=list)  # [HashRequest]
@@ -101,7 +105,7 @@ class Actions:
     state_transfer: StateTarget | None = None
 
     def send(self, targets: list, msg: pb.Msg) -> "Actions":
-        self.sends.append(Send(targets=list(targets), msg=msg))
+        self.sends.append(Send(targets=targets, msg=msg))
         return self
 
     def hash(self, data: list, origin: pb.HashResult) -> "Actions":
@@ -124,7 +128,7 @@ class Actions:
 
     def forward_request(self, targets: list, ack: pb.RequestAck) -> "Actions":
         self.forward_requests.append(
-            Forward(targets=list(targets), request_ack=ack)
+            Forward(targets=targets, request_ack=ack)
         )
         return self
 
@@ -172,6 +176,12 @@ class Actions:
         return self
 
 
+# The shared hot-path empty: returned by handlers with nothing to emit so
+# callers can skip both the allocation and the concat via an identity check.
+# Must never be mutated — callers only read/concat it.
+EMPTY_ACTIONS = Actions()
+
+
 # ---------------------------------------------------------------------------
 # Results (reference: actions.go:216-261).  The runtime converts these to the
 # wire-level pb.HashResult / pb.CheckpointResult carried by the AddResults
@@ -179,13 +189,13 @@ class Actions:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class HashResult:
     digest: bytes
     request: HashRequest
 
 
-@dataclass
+@dataclass(slots=True)
 class CheckpointResult:
     checkpoint: CheckpointReq
     value: bytes
@@ -194,7 +204,7 @@ class CheckpointResult:
     reconfigurations: list = field(default_factory=list)  # [pb.Reconfiguration]
 
 
-@dataclass
+@dataclass(slots=True)
 class ActionResults:
     digests: list = field(default_factory=list)  # [HashResult]
     checkpoints: list = field(default_factory=list)  # [CheckpointResult]
@@ -205,8 +215,12 @@ def results_to_event(results: ActionResults) -> pb.EventActionResults:
     (reference: mirbft.go:392-413)."""
     digests = []
     for hr in results.digests:
+        # The origin IS a pb.HashResult with an empty digest, created by the
+        # state machine solely for this round trip: fill it in place rather
+        # than allocating a copy (hundreds of thousands per ladder run).
         origin = hr.request.origin
-        digests.append(pb.HashResult(digest=hr.digest, type=origin.type))
+        origin.digest = hr.digest
+        digests.append(origin)
     checkpoints = []
     for cr in results.checkpoints:
         checkpoints.append(

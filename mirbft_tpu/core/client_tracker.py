@@ -47,7 +47,7 @@ _NULL = b""  # digest key of the null request
 
 # Shared no-op result for hot paths; MUST never be mutated (callers only
 # ever concat it into their own Actions).
-_EMPTY_ACTIONS = Actions()
+from .actions import EMPTY_ACTIONS as _EMPTY_ACTIONS  # noqa: E402  (shared hot-path empty)
 
 _CORRECT_FETCH_TICKS = 4
 _FETCH_TIMEOUT_TICKS = 4
@@ -161,7 +161,7 @@ class AvailableList:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class ClientRequest:
     ack: pb.RequestAck
     agreements: set = field(default_factory=set)  # node IDs acking this digest
@@ -193,6 +193,23 @@ class ClientReqNo:
     req_no) (reference: client_tracker.go:711-1016; the doc comment there
     explains the null-request byzantine fallback)."""
 
+    __slots__ = (
+        "client_id",
+        "req_no",
+        "valid_after_seq_no",
+        "network_config",
+        "committed",
+        "non_null_voters",
+        "requests",
+        "weak_requests",
+        "strong_requests",
+        "my_requests",
+        "acks_sent",
+        "ticks_since_ack",
+        "_weak_quorum",
+        "_strong_quorum",
+    )
+
     def __init__(
         self,
         client_id: int,
@@ -213,9 +230,19 @@ class ClientReqNo:
         self.my_requests: dict[bytes, ClientRequest] = {}  # persisted locally
         self.acks_sent = 0
         self.ticks_since_ack = 0
+        # Cached quorum thresholds: recomputing them per ack dominated the
+        # ladder profile (the ack fan-in is the hottest path in the core).
+        if network_config is not None:
+            self._weak_quorum = some_correct_quorum(network_config)
+            self._strong_quorum = intersection_quorum(network_config)
+        else:
+            # Set by reinitialize() before any ack can be applied.
+            self._weak_quorum = self._strong_quorum = None
 
     def reinitialize(self, network_config: pb.NetworkConfig) -> None:
         self.network_config = network_config
+        self._weak_quorum = some_correct_quorum(network_config)
+        self._strong_quorum = intersection_quorum(network_config)
         old_requests = self.requests
         self.non_null_voters = set()
         self.requests = {}
@@ -285,23 +312,31 @@ class ClientReqNo:
         leaves its live path unguarded, client_tracker.go:379), except when
         ``force`` marks the digest known-correct (weak quorum during
         three-phase commit, or epoch change)."""
+        requests = self.requests
         if ack.digest:
+            key = ack.digest
             if not force and source in self.non_null_voters:
-                key = ack.digest
-                existing = self.requests.get(key)
+                existing = requests.get(key)
                 if existing is None or source not in existing.agreements:
                     return  # second distinct non-null vote: ignored
             self.non_null_voters.add(source)
+        else:
+            key = _NULL
 
-        req = self.client_req(ack)
-        req.agreements.add(source)
+        req = requests.get(key)
+        if req is None:
+            req = ClientRequest(ack=ack)
+            requests[key] = req
+        agreements = req.agreements
+        agreements.add(source)
 
-        if len(req.agreements) < some_correct_quorum(self.network_config):
+        count = len(agreements)
+        if count < self._weak_quorum:
             return
-        self.weak_requests[ack.digest or _NULL] = req
-        if len(req.agreements) < intersection_quorum(self.network_config):
+        self.weak_requests[key] = req
+        if count < self._strong_quorum:
             return
-        self.strong_requests[ack.digest or _NULL] = req
+        self.strong_requests[key] = req
 
     def tick(self) -> Actions:
         if self.committed is not None:
@@ -309,64 +344,86 @@ class ClientReqNo:
             # the shared empty saves ~1M allocations on ladder-scale runs.
             # Callers only concat tick results (never mutate them).
             return _EMPTY_ACTIONS
+        if not self.my_requests and not self.weak_requests:
+            # Acks below the weak quorum and nothing held locally: no
+            # section of the tick logic can fire (rebroadcast requires
+            # acks_sent > 0, which implies a held request).
+            return _EMPTY_ACTIONS
 
-        actions = Actions()
+        my = self.my_requests
+        weak = self.weak_requests
+        actions = None
+        n_weak = len(weak)
 
         # 1. Conflicting correct requests and no commit → promote null.
-        if _NULL not in self.my_requests and len(self.weak_requests) > 1:
+        if n_weak > 1 and _NULL not in my:
             null_ack = pb.RequestAck(
                 client_id=self.client_id, req_no=self.req_no
             )
             null_req = self.client_req(null_ack)
             null_req.stored = True
-            self.my_requests[_NULL] = null_req
+            my[_NULL] = null_req
             self.acks_sent = 1
             self.ticks_since_ack = 0
-            actions.send(
+            actions = Actions().send(
                 self.network_config.nodes, pb.Msg(type=null_ack)
             ).store_request(pb.ForwardRequest(request_ack=null_ack))
 
-        # 2. Exactly one correct request we don't hold: fetch it after a
-        # few ticks of patience.
-        if len(self.weak_requests) == 1:
-            (cr,) = self.weak_requests.values()
-            if not cr.stored and not cr.fetching:
-                if cr.ticks_correct <= _CORRECT_FETCH_TICKS:
-                    cr.ticks_correct += 1
-                else:
-                    actions.concat(cr.fetch())
-
-        # 3. Refetch correct requests whose fetch timed out.
-        to_fetch = []
-        for cr in self.weak_requests.values():
-            if not cr.fetching:
-                continue
-            if cr.ticks_fetching <= _FETCH_TIMEOUT_TICKS:
-                cr.ticks_fetching += 1
-                continue
-            cr.fetching = False
-            to_fetch.append(cr)
-        to_fetch.sort(key=lambda cr: cr.ack.digest, reverse=True)
-        for cr in to_fetch:
-            actions.concat(cr.fetch())
+        # 2+3. Fetch machinery — only when some correct request is not
+        # held locally or has a fetch in flight (in the steady state every
+        # weak request is stored and this whole block is one scan).
+        needs_fetch_scan = False
+        for cr in weak.values():
+            if (not cr.stored) or cr.fetching:
+                needs_fetch_scan = True
+                break
+        if needs_fetch_scan:
+            if actions is None:
+                actions = Actions()
+            # 2. Exactly one correct request we don't hold: fetch it after
+            # a few ticks of patience.
+            if n_weak == 1:
+                (cr,) = weak.values()
+                if not cr.stored and not cr.fetching:
+                    if cr.ticks_correct <= _CORRECT_FETCH_TICKS:
+                        cr.ticks_correct += 1
+                    else:
+                        actions.concat(cr.fetch())
+            # 3. Refetch correct requests whose fetch timed out.
+            to_fetch = []
+            for cr in weak.values():
+                if not cr.fetching:
+                    continue
+                if cr.ticks_fetching <= _FETCH_TIMEOUT_TICKS:
+                    cr.ticks_fetching += 1
+                    continue
+                cr.fetching = False
+                to_fetch.append(cr)
+            to_fetch.sort(key=lambda cr: cr.ack.digest, reverse=True)
+            for cr in to_fetch:
+                actions.concat(cr.fetch())
 
         # 4. Rebroadcast our ACK with linear backoff.
-        if self.acks_sent == 0:
-            return actions
-        if self.ticks_since_ack != self.acks_sent * _ACK_RESEND_TICKS:
+        acks_sent = self.acks_sent
+        if acks_sent == 0:
+            return actions if actions is not None else _EMPTY_ACTIONS
+        if self.ticks_since_ack != acks_sent * _ACK_RESEND_TICKS:
             self.ticks_since_ack += 1
-            return actions
+            return actions if actions is not None else _EMPTY_ACTIONS
 
-        if len(self.my_requests) > 1:
-            ack = self.my_requests[_NULL].ack
-        elif len(self.my_requests) == 1:
-            (only,) = self.my_requests.values()
+        n_my = len(my)
+        if n_my > 1:
+            ack = my[_NULL].ack
+        elif n_my == 1:
+            (only,) = my.values()
             ack = only.ack
         else:
             raise AssertionError("acks sent but no request held")
 
-        self.acks_sent += 1
+        self.acks_sent = acks_sent + 1
         self.ticks_since_ack = 0
+        if actions is None:
+            actions = Actions()
         actions.send(self.network_config.nodes, pb.Msg(type=ack))
         return actions
 
@@ -388,6 +445,18 @@ class ClientWaiter:
 
 
 class Client:
+    __slots__ = (
+        "logger",
+        "client_state",
+        "network_config",
+        "low_watermark",
+        "high_watermark",
+        "next_ready_mark",
+        "req_no_map",
+        "client_waiter",
+        "_tick_pending",
+    )
+
     def __init__(self, logger=None):
         self.logger = logger
         self.client_state: pb.NetworkClient | None = None
@@ -397,6 +466,11 @@ class Client:
         self.next_ready_mark = 0
         self.req_no_map: dict[int, ClientReqNo] = {}
         self.client_waiter: ClientWaiter | None = None
+        # req_nos with tick-relevant activity (acks observed or a local
+        # copy held).  Untouched window slots — the vast majority at any
+        # instant — are skipped by tick() entirely; entries are discarded
+        # lazily once committed or garbage collected.
+        self._tick_pending: set = set()
 
     def req_nos(self):
         """All live ClientReqNos in req_no order."""
@@ -467,6 +541,13 @@ class Client:
             crn.reinitialize(network_config)
             self.req_no_map[req_no] = crn
 
+        self._tick_pending = {
+            req_no
+            for req_no, crn in self.req_no_map.items()
+            if crn.committed is None
+            and (crn.my_requests or crn.weak_requests or crn.requests)
+        }
+
     def allocate(self, starting_at_seq_no: int, state: pb.NetworkClient) -> None:
         """Extend the window at a checkpoint boundary; the newly usable tail
         only becomes proposable after the *next* checkpoint (reference:
@@ -489,8 +570,8 @@ class Client:
                 req_no=req_no,
                 valid_after_seq_no=starting_at_seq_no
                 + self.network_config.checkpoint_interval,
+                network_config=self.network_config,
             )
-            crn.network_config = self.network_config
             self.req_no_map[req_no] = crn
 
         self.high_watermark = new_high
@@ -536,6 +617,7 @@ class Client:
         was_weak = key in crn.weak_requests
         crn.apply_request_ack(source, ack, force=force)
         newly_correct = not was_weak and key in crn.weak_requests
+        self._tick_pending.add(ack.req_no)
         return crn.requests.get(key), crn, newly_correct
 
     def in_watermarks(self, req_no: int) -> bool:
@@ -548,10 +630,26 @@ class Client:
         return crn
 
     def tick(self) -> Actions:
-        actions = Actions()
-        for crn in self.req_nos():
-            actions.concat(crn.tick())
-        return actions
+        if not self._tick_pending:
+            return _EMPTY_ACTIONS
+        actions = None
+        done = None
+        for req_no in sorted(self._tick_pending):
+            crn = self.req_no_map.get(req_no)
+            if crn is None or crn.committed is not None:
+                if done is None:
+                    done = []
+                done.append(req_no)
+                continue
+            crn_actions = crn.tick()
+            if crn_actions is not _EMPTY_ACTIONS:
+                if actions is None:
+                    actions = crn_actions
+                else:
+                    actions.concat(crn_actions)
+        if done is not None:
+            self._tick_pending.difference_update(done)
+        return actions if actions is not None else _EMPTY_ACTIONS
 
 
 # ---------------------------------------------------------------------------
@@ -635,11 +733,12 @@ class ClientTracker:
 
     def filter(self, _source: int, msg: pb.Msg) -> Applyable:
         inner = msg.type
-        if isinstance(inner, pb.RequestAck):
+        cls = inner.__class__  # exact types only: pb classes have no subclasses
+        if cls is pb.RequestAck:
             ack = inner
-        elif isinstance(inner, pb.ForwardRequest):
+        elif cls is pb.ForwardRequest:
             ack = inner.request_ack
-        elif isinstance(inner, pb.FetchRequest):
+        elif cls is pb.FetchRequest:
             return Applyable.CURRENT
         else:
             raise AssertionError(
@@ -654,20 +753,122 @@ class ClientTracker:
             return Applyable.FUTURE
         return Applyable.CURRENT
 
+    def step_ack(self, source: int, msg: pb.Msg) -> Actions:
+        """Fast path for RequestAck — the dominant message at ladder scale
+        (n^2 per request).  Equivalent to step() with the filter/apply_msg/
+        ack/Client.ack chain flattened into one frame; ``ack()`` below stays
+        the semantic reference for this logic."""
+        ack = msg.type
+        client = self.clients.get(ack.client_id)
+        if client is None:
+            # Client may appear via reconfiguration: buffer as FUTURE.
+            self.msg_buffers[source].store(msg)
+            return _EMPTY_ACTIONS
+        req_no = ack.req_no
+        if req_no < client.low_watermark:
+            return _EMPTY_ACTIONS
+        if req_no > client.high_watermark:
+            self.msg_buffers[source].store(msg)
+            return _EMPTY_ACTIONS
+        crn = client.req_no_map.get(req_no)
+        if crn is None:
+            raise AssertionError(
+                f"client {ack.client_id}: req_no {req_no} missing inside "
+                f"window [{client.low_watermark}, {client.high_watermark}]"
+            )
+        if crn.committed is not None:
+            # Same late-ack drop as step_ack_many: the two delivery paths
+            # must agree so node state never depends on transport framing.
+            return _EMPTY_ACTIONS
+        key = ack.digest or _NULL
+        weak = crn.weak_requests
+        was_weak = key in weak
+        crn.apply_request_ack(source, ack)
+        client._tick_pending.add(req_no)
+        if not was_weak and key in weak:
+            self.available_list.push_back(crn.requests.get(key))
+        if req_no == client.next_ready_mark and crn.strong_requests:
+            self.check_ready(client, crn)
+        return _EMPTY_ACTIONS
+
+    def step_ack_many(self, source: int, msgs: list) -> None:
+        """Bulk form of step_ack for one inbound frame: identical semantics,
+        per-frame rather than per-msg frame setup.  ``msgs`` must all carry
+        RequestAck payloads."""
+        clients_get = self.clients.get
+        available_push = self.available_list.push_back
+        for msg in msgs:
+            ack = msg.type
+            client = clients_get(ack.client_id)
+            if client is None:
+                self.msg_buffers[source].store(msg)
+                continue
+            req_no = ack.req_no
+            if req_no < client.low_watermark:
+                continue
+            if req_no > client.high_watermark:
+                self.msg_buffers[source].store(msg)
+                continue
+            crn = client.req_no_map.get(req_no)
+            if crn is None:
+                raise AssertionError(
+                    f"client {ack.client_id}: req_no {req_no} missing inside "
+                    f"window [{client.low_watermark}, "
+                    f"{client.high_watermark}]"
+                )
+            if crn.committed is not None:
+                # Late ack for an already-committed req_no: its vote can no
+                # longer influence anything (the request ordered; fetches
+                # and null promotion are moot).  Dropping it here skips the
+                # accounting the slow path would still perform.
+                continue
+            # Inlined ClientReqNo.apply_request_ack (force=False) — that
+            # method stays the semantic reference for this logic.
+            digest = ack.digest
+            requests = crn.requests
+            if digest:
+                key = digest
+                if source in crn.non_null_voters:
+                    existing = requests.get(key)
+                    if existing is None or source not in existing.agreements:
+                        continue  # second distinct non-null vote: ignored
+                else:
+                    crn.non_null_voters.add(source)
+            else:
+                key = _NULL
+            weak = crn.weak_requests
+            was_weak = key in weak
+            req = requests.get(key)
+            if req is None:
+                req = ClientRequest(ack=ack)
+                requests[key] = req
+            agreements = req.agreements
+            agreements.add(source)
+            count = len(agreements)
+            if count >= crn._weak_quorum:
+                weak[key] = req
+                if count >= crn._strong_quorum:
+                    crn.strong_requests[key] = req
+                if not was_weak:
+                    available_push(req)
+            client._tick_pending.add(req_no)
+            if req_no == client.next_ready_mark and crn.strong_requests:
+                self.check_ready(client, crn)
+
     def step(self, source: int, msg: pb.Msg) -> Actions:
         verdict = self.filter(source, msg)
         if verdict is Applyable.PAST:
-            return Actions()
+            return _EMPTY_ACTIONS
         if verdict is Applyable.FUTURE:
             self.msg_buffers[source].store(msg)
-            return Actions()
+            return _EMPTY_ACTIONS
         return self.apply_msg(source, msg)
 
     def apply_msg(self, source: int, msg: pb.Msg) -> Actions:
         inner = msg.type
-        if isinstance(inner, pb.RequestAck):
+        if inner.__class__ is pb.RequestAck:
             self.ack(source, inner)
-            return Actions()
+            return _EMPTY_ACTIONS
         if isinstance(inner, pb.FetchRequest):
             return self.reply_fetch_request(
                 source, inner.client_id, inner.req_no, inner.digest
@@ -686,6 +887,7 @@ class ClientTracker:
             return Actions()  # client removed since the request was hashed
         if not client.in_watermarks(ack.req_no):
             return Actions()  # already committed / out of window
+        client._tick_pending.add(ack.req_no)
         return client.req_no(ack.req_no).apply_request_digest(ack, data)
 
     def reply_fetch_request(

@@ -29,6 +29,8 @@ from .msgbuffers import NodeBuffers
 from .persisted import Persisted
 from .preimage import request_hash_data
 
+from .actions import EMPTY_ACTIONS as _EMPTY_ACTIONS  # shared hot-path empty
+
 
 class _SMState(enum.Enum):
     UNINITIALIZED = 0
@@ -127,11 +129,21 @@ class StateMachine:
 
     def apply_event(self, event: pb.StateEvent) -> Actions:
         inner = event.type
-        actions = Actions()
-
         # Exact-type dispatch ordered by frequency (pb event classes have
         # no subclasses; this chain runs once per event of every node).
         inner_type = type(inner)
+
+        if inner_type is pb.EventPropose:
+            # Fast path: a propose only emits its hash action — it cannot
+            # make a checkpoint collectable or advance the epoch, so the
+            # GC/fixed-point epilogue below is statically a no-op for it.
+            if self._state is not _SMState.INITIALIZED:
+                raise AssertionError(
+                    "cannot apply EventPropose before initialization"
+                )
+            return self._propose(inner.request)
+
+        actions = Actions()
 
         if inner_type is pb.EventInitialize:
             self._initialize(inner.initial_parms)
@@ -156,7 +168,37 @@ class StateMachine:
                     f"cannot apply {type(inner).__name__} before initialization"
                 )
             if inner_type is pb.EventStep:
-                actions.concat(self._step(inner.source, inner.msg))
+                stepped = self._step(inner.source, inner.msg)
+                if stepped is not _EMPTY_ACTIONS:
+                    actions.concat(stepped)
+            elif inner_type is pb.EventStepBatch:
+                # One transport frame, several messages: apply in list order,
+                # exactly as if each arrived as its own EventStep.  RequestAck
+                # dispatch is inlined: acks dominate batch contents at scale
+                # and their handler never emits actions.
+                source = inner.source
+                msgs = inner.msgs
+                ack_cls = pb.RequestAck
+                step = self._step
+                step_ack_many = self.client_tracker.step_ack_many
+                i = 0
+                n = len(msgs)
+                while i < n:
+                    if msgs[i].type.__class__ is ack_cls:
+                        # Bulk-apply the run of consecutive acks (frames
+                        # are overwhelmingly pure ack runs at scale).
+                        j = i + 1
+                        while j < n and msgs[j].type.__class__ is ack_cls:
+                            j += 1
+                        step_ack_many(
+                            source, msgs if j - i == n else msgs[i:j]
+                        )
+                        i = j
+                        continue
+                    stepped = step(source, msgs[i])
+                    if stepped is not _EMPTY_ACTIONS:
+                        actions.concat(stepped)
+                    i += 1
             elif inner_type is pb.EventTick:
                 actions.concat(self.client_tracker.tick())
                 actions.concat(self.epoch_tracker.tick())
@@ -231,13 +273,17 @@ class StateMachine:
         )
 
     def _step(self, source: int, msg: pb.Msg) -> Actions:
-        inner = msg.type
-        if isinstance(inner, (pb.RequestAck, pb.FetchRequest, pb.ForwardRequest)):
+        # Exact-type checks ordered by frequency (RequestAcks dominate all
+        # traffic at ladder scale; pb classes have no subclasses).
+        cls = msg.type.__class__
+        if cls is pb.RequestAck:
+            return self.client_tracker.step_ack(source, msg)
+        if cls is pb.FetchRequest or cls is pb.ForwardRequest:
             return self.client_tracker.step(source, msg)
-        if isinstance(inner, pb.Checkpoint):
+        if cls is pb.Checkpoint:
             self.checkpoint_tracker.step(source, msg)
-            return Actions()
-        if isinstance(inner, (pb.FetchBatch, pb.ForwardBatch)):
+            return _EMPTY_ACTIONS
+        if cls is pb.FetchBatch or cls is pb.ForwardBatch:
             return self.batch_tracker.step(source, msg)
         # Everything else is epoch-scoped.
         return self.epoch_tracker.step(source, msg)

@@ -76,6 +76,24 @@ def redact_event(event: pb.StateEvent) -> pb.StateEvent:
                 msg=pb.Msg(type=replace(fwd, request_data=b"")),
             )
         )
+    if isinstance(inner, pb.EventStepBatch):
+        if not any(
+            isinstance(m.type, pb.ForwardRequest) and m.type.request_data
+            for m in inner.msgs
+        ):
+            return event
+        return pb.StateEvent(
+            type=pb.EventStepBatch(
+                source=inner.source,
+                msgs=[
+                    pb.Msg(type=replace(m.type, request_data=b""))
+                    if isinstance(m.type, pb.ForwardRequest)
+                    and m.type.request_data
+                    else m
+                    for m in inner.msgs
+                ],
+            )
+        )
     if isinstance(inner, pb.EventActionResults):
         redacted = []
         changed = False

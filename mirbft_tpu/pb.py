@@ -24,7 +24,7 @@ from .wire import BOOL, BYTES, I32, U32, U64, Nested, OneOf, Rep
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class NetworkConfig:
     """Consensus-replicated network configuration (mirbft.proto:23-77)."""
 
@@ -35,7 +35,7 @@ class NetworkConfig:
     f: int = 0  # byzantine faults tolerated, < N/3
 
 
-@dataclass
+@dataclass(slots=True)
 class NetworkClient:
     """Per-client window state, reflected in checkpoints (mirbft.proto:79-106)."""
 
@@ -46,18 +46,18 @@ class NetworkClient:
     committed_mask: bytes = b""  # bitmask of commits above low_watermark
 
 
-@dataclass
+@dataclass(slots=True)
 class ReconfigNewClient:
     id: int = 0
     width: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class ReconfigRemoveClient:
     client_id: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class Reconfiguration:
     """Oneof: ReconfigNewClient | ReconfigRemoveClient | NetworkConfig
     (mirbft.proto:117-128)."""
@@ -65,7 +65,7 @@ class Reconfiguration:
     type: object = None
 
 
-@dataclass
+@dataclass(slots=True)
 class NetworkState:
     config: NetworkConfig | None = None
     clients: list = field(default_factory=list)  # [NetworkClient]
@@ -78,14 +78,14 @@ class NetworkState:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Request:
     client_id: int = 0
     req_no: int = 0
     data: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class RequestAck:
     client_id: int = 0
     req_no: int = 0
@@ -97,20 +97,20 @@ class RequestAck:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class EpochConfig:
     number: int = 0
     leaders: list = field(default_factory=list)  # node IDs
     planned_expiration: int = 0  # last seq_no this epoch may preprepare
 
 
-@dataclass
+@dataclass(slots=True)
 class Checkpoint:
     seq_no: int = 0
     value: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class NewEpochConfig:
     config: EpochConfig | None = None
     starting_checkpoint: Checkpoint | None = None
@@ -119,14 +119,14 @@ class NewEpochConfig:
     final_preprepares: list = field(default_factory=list)  # [bytes]
 
 
-@dataclass
+@dataclass(slots=True)
 class EpochChangeSetEntry:
     epoch: int = 0
     seq_no: int = 0
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class EpochChange:
     """PBFT view-change message, slightly adapted to Mir (mirbft.proto:273-293)."""
 
@@ -136,19 +136,19 @@ class EpochChange:
     q_set: list = field(default_factory=list)  # [EpochChangeSetEntry]
 
 
-@dataclass
+@dataclass(slots=True)
 class EpochChangeAck:
     originator: int = 0
     epoch_change: EpochChange | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class RemoteEpochChange:
     node_id: int = 0
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class NewEpoch:
     """PBFT NewView + Bracha reliable broadcast of the config (mirbft.proto:330-351)."""
 
@@ -161,28 +161,28 @@ class NewEpoch:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Preprepare:
     seq_no: int = 0
     epoch: int = 0
     batch: list = field(default_factory=list)  # [RequestAck]
 
 
-@dataclass
+@dataclass(slots=True)
 class Prepare:
     seq_no: int = 0
     epoch: int = 0
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class Commit:
     seq_no: int = 0
     epoch: int = 0
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class Suspect:
     epoch: int = 0
 
@@ -192,20 +192,20 @@ class Suspect:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class FetchBatch:
     seq_no: int = 0
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class ForwardBatch:
     seq_no: int = 0
     request_acks: list = field(default_factory=list)  # [RequestAck]
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class FetchRequest:
     """Distinct type for the fetch_request oneof arm (the reference reuses
     RequestAck at mirbft.proto:207; a distinct type keeps step routing
@@ -216,13 +216,13 @@ class FetchRequest:
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class ForwardRequest:
     request_ack: RequestAck | None = None
     request_data: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class NewEpochEcho:
     """Bracha echo of a NewEpochConfig.  The reference reuses NewEpochConfig
     for both the echo (tag 9) and ready (tag 10) arms of the Msg oneof
@@ -232,14 +232,14 @@ class NewEpochEcho:
     new_epoch_config: NewEpochConfig | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class NewEpochReady:
     """Bracha ready of a NewEpochConfig (see NewEpochEcho)."""
 
     new_epoch_config: NewEpochConfig | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class Msg:
     """The wire-message oneof: 15 types (mirbft.proto:193-211)."""
 
@@ -251,7 +251,7 @@ class Msg:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class QEntry:
     """Persisted before a batch is Preprepared (mirbft.proto:170-177)."""
 
@@ -260,7 +260,7 @@ class QEntry:
     requests: list = field(default_factory=list)  # [RequestAck]
 
 
-@dataclass
+@dataclass(slots=True)
 class PEntry:
     """Persisted before a batch is Prepared (mirbft.proto:179-184)."""
 
@@ -268,7 +268,7 @@ class PEntry:
     digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class CEntry:
     """Persisted before a Checkpoint message is sent (mirbft.proto:186-191)."""
 
@@ -277,7 +277,7 @@ class CEntry:
     network_state: NetworkState | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class NEntry:
     """New sequence allocation; persisted before log truncation (mirbft.proto:148-152)."""
 
@@ -285,21 +285,21 @@ class NEntry:
     epoch_config: EpochConfig | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class FEntry:
     """Epoch gracefully ended (mirbft.proto:154-156)."""
 
     ends_epoch_config: EpochConfig | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class ECEntry:
     """Epoch change sent; truncation halts until the next epoch (mirbft.proto:160-162)."""
 
     epoch_number: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class TEntry:
     """State transfer requested (mirbft.proto:164-168)."""
 
@@ -307,7 +307,7 @@ class TEntry:
     value: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class Persistent:
     """WAL entry oneof: 8 types (mirbft.proto:131-143)."""
 
@@ -319,20 +319,20 @@ class Persistent:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class HashOriginRequest:
     source: int = 0
     request: Request | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class HashOriginVerifyRequest:
     source: int = 0
     request_ack: RequestAck | None = None
     request_data: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class HashOriginBatch:
     source: int = 0
     epoch: int = 0
@@ -340,7 +340,7 @@ class HashOriginBatch:
     request_acks: list = field(default_factory=list)  # [RequestAck]
 
 
-@dataclass
+@dataclass(slots=True)
 class HashOriginVerifyBatch:
     source: int = 0
     seq_no: int = 0
@@ -348,20 +348,20 @@ class HashOriginVerifyBatch:
     expected_digest: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class HashOriginEpochChange:
     source: int = 0
     origin: int = 0
     epoch_change: EpochChange | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class HashResult:
     digest: bytes = b""
     type: object = None  # one of the 5 HashOrigin* classes
 
 
-@dataclass
+@dataclass(slots=True)
 class CheckpointResult:
     """Consumer-computed checkpoint (mirbft.proto:450-455)."""
 
@@ -376,7 +376,7 @@ class CheckpointResult:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class InitialParameters:
     id: int = 0
     batch_size: int = 0
@@ -386,60 +386,74 @@ class InitialParameters:
     buffer_size: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class EventInitialize:
     initial_parms: InitialParameters | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class EventLoadEntry:
     index: int = 0
     data: Persistent | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class EventLoadRequest:
     request_ack: RequestAck | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class EventCompleteInitialization:
     pass
 
 
-@dataclass
+@dataclass(slots=True)
 class EventActionResults:
     digests: list = field(default_factory=list)  # [HashResult]
     checkpoints: list = field(default_factory=list)  # [CheckpointResult]
 
 
-@dataclass
+@dataclass(slots=True)
 class EventTransfer:
     c_entry: CEntry | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class EventPropose:
     request: Request | None = None
 
 
-@dataclass
+@dataclass(slots=True)
 class EventStep:
     source: int = 0
     msg: Msg | None = None
 
 
-@dataclass
+@dataclass(slots=True)
+class EventStepBatch:
+    """One inbound transport frame carrying several messages from the same
+    peer.  Semantically identical to delivering each message as its own
+    EventStep in list order; the batch form exists so executors can coalesce
+    the per-target sends of one Actions batch into one delivery (the n^2
+    RequestAck fan-out otherwise dominates event counts at ladder scale).
+    The reference delivers messages individually (reference:
+    processor.go:95-103); batching is a framework-level transport feature."""
+
+    source: int = 0
+    msgs: list = field(default_factory=list)  # [Msg]
+
+
+@dataclass(slots=True)
 class EventTick:
     pass
 
 
-@dataclass
+@dataclass(slots=True)
 class EventActionsReceived:
     pass
 
 
-@dataclass
+@dataclass(slots=True)
 class StateEvent:
     """The state-machine input oneof: 10 types (mirbft.proto:394-405)."""
 
@@ -660,6 +674,7 @@ EventActionResults._spec_ = (
 EventTransfer._spec_ = (("c_entry", Nested(CEntry)),)
 EventPropose._spec_ = (("request", Nested(Request)),)
 EventStep._spec_ = (("source", U64), ("msg", Nested(Msg)))
+EventStepBatch._spec_ = (("source", U64), ("msgs", Rep(Nested(Msg))))
 EventTick._spec_ = ()
 EventActionsReceived._spec_ = ()
 StateEvent._spec_ = (
@@ -676,6 +691,7 @@ StateEvent._spec_ = (
             (8, EventStep),
             (9, EventTick),
             (10, EventActionsReceived),
+            (11, EventStepBatch),
             allow_unset=False,
         ),
     ),
@@ -733,6 +749,7 @@ _ALL_MESSAGES = [
     EventTransfer,
     EventPropose,
     EventStep,
+    EventStepBatch,
     EventTick,
     EventActionsReceived,
     StateEvent,

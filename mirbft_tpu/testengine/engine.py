@@ -86,6 +86,13 @@ class NodeState:
     # window (reported with the next CheckpointResult, reference:
     # actions.go:234-261).
     pending_reconfigs: list = field(default_factory=list)
+    # Actions accumulated since the last executor pass.  The executor runs
+    # once per ``process_latency`` window over everything accumulated —
+    # the reference serializer's Actions accumulation between Ready()
+    # reads (reference: serializer.go:216-223) — which is what lets sends
+    # coalesce per target and hashes batch per launch.
+    pending: act.Actions = field(default_factory=act.Actions)
+    process_scheduled: bool = False
 
 
 class _ClientState:
@@ -110,6 +117,7 @@ class _ClientState:
         self._total_reqs = value
         if self._owner is not None:
             self._owner._total_reqs_cache = None
+            self._owner._progress = True
 
     def request(self, req_no: int) -> pb.Request:
         # Deterministic payload, distinct per (client, req_no).
@@ -212,6 +220,10 @@ class Recorder:
         self._committed_counts: dict[int, int] = dict.fromkeys(
             range(node_count), 0
         )
+        # Set whenever commitment state could have changed; drain_clients
+        # only re-evaluates fully_committed() when it is — the predicate is
+        # O(nodes) and running it every step dominated large-run profiles.
+        self._progress = True
         self._total_reqs_cache: int | None = None
         # record=False skips the in-memory recorded_events list (an
         # interceptor still sees every event) — pod-scale runs are tens of
@@ -244,6 +256,8 @@ class Recorder:
             state = NodeState()
             self.node_states[node] = state
         state.crashed = False
+        state.pending = act.Actions()
+        state.process_scheduled = False
 
         my_params = pb.InitialParameters(
             id=node,
@@ -359,13 +373,28 @@ class Recorder:
         if not self._queue:
             return False
         when, _seq, node, event = heapq.heappop(self._queue)
-        self.now = max(self.now, when)
+        if when > self.now:
+            self.now = when
+            if self.hash_plane is not None:
+                # Simulated time advanced: every hash submitted at earlier
+                # instants is a complete wave the plane may launch now,
+                # overlapping device work with the events between here and
+                # the results delivery.
+                self.hash_plane.on_time(when)
         if event is _RESTART:
             self.restart(node)
             return True
         machine = self.machines[node]
         state = self.node_states[node]
         if state.crashed:
+            return True
+        if event is _PROCESS:
+            # The executor pass: run everything this node accumulated since
+            # the pass was scheduled.
+            state.process_scheduled = False
+            pending = state.pending
+            state.pending = act.Actions()
+            self._execute(node, state, pending)
             return True
         if self.signature_plane is not None and isinstance(
             event.type, pb.EventPropose
@@ -400,7 +429,20 @@ class Recorder:
             self._adopt_transferred_state(node, event.type.c_entry)
 
         actions = machine.apply_event(event)
-        self._execute(node, state, actions)
+        if not actions.is_empty():
+            state.pending.concat(actions)
+            if not state.process_scheduled:
+                state.process_scheduled = True
+                heapq.heappush(
+                    self._queue,
+                    (
+                        self.now + self.params.process_latency,
+                        self._seq,
+                        node,
+                        _PROCESS,
+                    ),
+                )
+                self._seq += 1
         return True
 
     def _adopt_transferred_state(self, node: int, c_entry: pb.CEntry) -> None:
@@ -418,6 +460,7 @@ class Recorder:
                 )
                 self._committed_counts[node] += len(req_nos - mine)
                 mine |= req_nos
+            self._progress = True
             return
 
     def _execute(self, node: int, state: NodeState, actions: act.Actions) -> None:
@@ -440,34 +483,96 @@ class Recorder:
             )
 
         send_delay = persist_delay + self.params.link_latency
-        for send in actions.sends:
-            if self.checkpoint_certs is not None:
-                self.checkpoint_certs.observe(node, send.msg)
-            for target in send.targets:
-                self._schedule(
-                    send_delay,
-                    target,
-                    pb.StateEvent(
-                        type=pb.EventStep(source=node, msg=send.msg)
-                    ),
+        if self.manglers:
+            # Per-msg scheduling: mangler matchers (drop/jitter/duplicate by
+            # msg type) operate on individual EventStep events.
+            for send in actions.sends:
+                if self.checkpoint_certs is not None:
+                    self.checkpoint_certs.observe(node, send.msg)
+                for target in send.targets:
+                    self._schedule(
+                        send_delay,
+                        target,
+                        pb.StateEvent(
+                            type=pb.EventStep(source=node, msg=send.msg)
+                        ),
+                    )
+            for fwd in actions.forward_requests:
+                stored = state.reqstore.get(fwd.request_ack.digest)
+                if stored is None:
+                    continue
+                _ack, data = stored
+                msg = pb.Msg(
+                    type=pb.ForwardRequest(
+                        request_ack=fwd.request_ack, request_data=data
+                    )
                 )
-
-        for fwd in actions.forward_requests:
-            stored = state.reqstore.get(fwd.request_ack.digest)
-            if stored is None:
-                continue
-            _ack, data = stored
-            msg = pb.Msg(
-                type=pb.ForwardRequest(
-                    request_ack=fwd.request_ack, request_data=data
-                )
+                for target in fwd.targets:
+                    self._schedule(
+                        send_delay,
+                        target,
+                        pb.StateEvent(
+                            type=pb.EventStep(source=node, msg=msg)
+                        ),
+                    )
+        else:
+            # Coalesce this pass's sends into one frame per distinct target
+            # set — the transport-level batching that collapses the n^2
+            # per-request ack fan-out into per-(source,target) deliveries.
+            # All targets of a group share one event object.  A target
+            # appearing in several groups receives the groups as separate
+            # frames in emission order; relative reordering of msgs across
+            # groups is fine (the network is unordered by assumption) and
+            # deterministic (insertion-ordered dicts).
+            groups: dict[tuple, list] = {}
+            observe = (
+                self.checkpoint_certs.observe
+                if self.checkpoint_certs is not None
+                else None
             )
-            for target in fwd.targets:
-                self._schedule(
-                    send_delay,
-                    target,
-                    pb.StateEvent(type=pb.EventStep(source=node, msg=msg)),
+            last_targets = None  # sends overwhelmingly share one list object
+            last_key = None
+            for send in actions.sends:
+                if observe is not None:
+                    observe(node, send.msg)
+                targets = send.targets
+                if targets is last_targets:
+                    key = last_key
+                else:
+                    key = tuple(targets)
+                    last_targets, last_key = targets, key
+                frame = groups.get(key)
+                if frame is None:
+                    groups[key] = [send.msg]
+                else:
+                    frame.append(send.msg)
+            for fwd in actions.forward_requests:
+                stored = state.reqstore.get(fwd.request_ack.digest)
+                if stored is None:
+                    continue
+                _ack, data = stored
+                msg = pb.Msg(
+                    type=pb.ForwardRequest(
+                        request_ack=fwd.request_ack, request_data=data
+                    )
                 )
+                key = tuple(fwd.targets)
+                frame = groups.get(key)
+                if frame is None:
+                    groups[key] = [msg]
+                else:
+                    frame.append(msg)
+            for targets, msgs in groups.items():
+                if len(msgs) == 1:
+                    event = pb.StateEvent(
+                        type=pb.EventStep(source=node, msg=msgs[0])
+                    )
+                else:
+                    event = pb.StateEvent(
+                        type=pb.EventStepBatch(source=node, msgs=msgs)
+                    )
+                for target in targets:
+                    self._schedule(send_delay, target, event)
 
         results = act.ActionResults()
         if actions.hashes:
@@ -528,6 +633,7 @@ class Recorder:
         client = _ClientState(client_id, total_reqs=total_reqs, owner=self)
         self.clients[client_id] = client
         self._total_reqs_cache = None
+        self._progress = True
         for _ in range(min(total_reqs, 100)):
             self._submit_next_request(client, at_delay=0)
 
@@ -548,6 +654,7 @@ class Recorder:
                 if ack.req_no not in seen:
                     seen.add(ack.req_no)
                     self._committed_counts[node] += 1
+                    self._progress = True
                 if ack.req_no not in client.committed_anywhere:
                     # First commit anywhere slides the client's submission
                     # window (a deterministic stand-in for client waiters).
@@ -597,6 +704,7 @@ class Recorder:
 
     def crash(self, node: int) -> None:
         self.node_states[node].crashed = True
+        self._progress = True  # a crashed node leaves the commitment quorum
         self._queue = [
             entry
             for entry in self._queue
@@ -656,9 +764,13 @@ class Recorder:
     def drain_clients(self, max_steps: int = 100_000) -> int:
         """Run until every client's requests commit at every live node;
         returns the number of events processed (the determinism anchor)."""
+        check = True  # always evaluate on entry (drain may be a no-op)
         for _ in range(max_steps):
-            if self.fully_committed():
-                return self.event_count
+            if check or self._progress:
+                check = False
+                self._progress = False
+                if self.fully_committed():
+                    return self.event_count
             if not self.step():
                 raise AssertionError(
                     f"event queue drained before full commitment "
@@ -679,6 +791,18 @@ class _RestartSentinel:
 
 
 _RESTART = _RestartSentinel()
+
+
+class _ProcessSentinel:
+    """Queue marker: run the node's executor pass over its accumulated
+    Actions.  Harness machinery like _RESTART: not a StateEvent, never
+    recorded, never counted, never mangled."""
+
+    def __repr__(self):
+        return "<process>"
+
+
+_PROCESS = _ProcessSentinel()
 
 
 def _tick_event() -> pb.StateEvent:
