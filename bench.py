@@ -214,11 +214,23 @@ def main():
                     f"{NODES} nodes f={(NODES - 1) // 3}, {CLIENTS} clients, "
                     f"batch_size={BATCH_SIZE}, {total_reqs} reqs, "
                     f"ready_latency={READY_LATENCY_MS}ms, "
-                    "all digests via async SHA-256 kernel plane"
+                    "digests via async SHA-256 kernel plane (adaptive "
+                    "host fallback below the device threshold)"
                 ),
                 "p99_batch_digest_ms": round(p99_ms, 2),
-                "crypto_plane_launches": len(plane.flush_sizes),
+                "crypto_plane_launches": (
+                    plane.overlapped_launches + plane.demand_launches
+                ),
                 "crypto_plane_digests": sum(plane.flush_sizes),
+                # Flush-overlap breakdown: launches dispatched proactively
+                # at wave boundaries (device + D2H copy overlap engine
+                # progress) vs. launches forced synchronously by a resolve
+                # miss (pure blocking).
+                "crypto_plane_overlapped_launches": plane.overlapped_launches,
+                "crypto_plane_demand_launches": plane.demand_launches,
+                "crypto_plane_device_digests": plane.device_digests,
+                "crypto_plane_host_digests": plane.host_digests,
+                "crypto_plane_rescued_digests": plane.rescued_digests,
                 "engine_events": events,
                 "kernel_compressions_per_sec": round(
                     max(xla_rate, pallas_rate), 1

@@ -55,9 +55,23 @@ def pack_preimages(
     batch = next_pow2(len(messages))
     batch += (-batch) % batch_floor
 
-    buf = np.zeros((batch, max_blocks * 64), dtype=np.uint8)
-    for i, p in enumerate(padded):
-        buf[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+    # One join + one frombuffer instead of a numpy row-assignment per
+    # message — the packing runs on the engine's critical path at every
+    # crypto-plane launch.
+    row_bytes = max_blocks * 64
+    zero = bytes(row_bytes)
+    parts = []
+    append = parts.append
+    for p in padded:
+        append(p)
+        if len(p) != row_bytes:
+            append(zero[: row_bytes - len(p)])
+    tail_rows = batch - len(messages)
+    if tail_rows:
+        append(bytes(row_bytes * tail_rows))
+    buf = np.frombuffer(b"".join(parts), dtype=np.uint8).reshape(
+        batch, row_bytes
+    )
 
     blocks = (
         buf.view(">u4")
