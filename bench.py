@@ -218,16 +218,14 @@ def main():
                     "host fallback below the device threshold)"
                 ),
                 "p99_batch_digest_ms": round(p99_ms, 2),
-                "crypto_plane_launches": (
-                    plane.overlapped_launches + plane.demand_launches
-                ),
+                "crypto_plane_launches": plane.overlapped_launches,
                 "crypto_plane_digests": sum(plane.flush_sizes),
-                # Flush-overlap breakdown: launches dispatched proactively
-                # at wave boundaries (device + D2H copy overlap engine
-                # progress) vs. launches forced synchronously by a resolve
-                # miss (pure blocking).
+                # Flush-overlap breakdown: device launches all dispatch
+                # proactively at wave boundaries (device + D2H copy overlap
+                # engine progress); a resolve miss forces a synchronous
+                # host-hash flush instead of a device launch.
                 "crypto_plane_overlapped_launches": plane.overlapped_launches,
-                "crypto_plane_demand_launches": plane.demand_launches,
+                "crypto_plane_demand_host_flushes": plane.demand_flushes,
                 "crypto_plane_device_digests": plane.device_digests,
                 "crypto_plane_host_digests": plane.host_digests,
                 "crypto_plane_rescued_digests": plane.rescued_digests,

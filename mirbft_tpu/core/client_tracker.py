@@ -41,7 +41,7 @@ from .actions import Actions
 from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
 from .persisted import Persisted
 from .preimage import request_hash_data
-from .quorum import bit_is_set, intersection_quorum, make_bitmask, set_bit, some_correct_quorum
+from .quorum import bit_is_set, intersection_quorum, make_bitmask, mask_ids, set_bit, some_correct_quorum
 
 _NULL = b""  # digest key of the null request
 
@@ -164,7 +164,11 @@ class AvailableList:
 @dataclass(slots=True)
 class ClientRequest:
     ack: pb.RequestAck
-    agreements: set = field(default_factory=set)  # node IDs acking this digest
+    # Node IDs acking this digest, as a bitmask over node id (bit i set =
+    # node i acked).  Node ids come from the replicated config and are
+    # small in practice; int masks turn the hottest per-ack bookkeeping
+    # (membership test, insert, cardinality) into single int ops.
+    agreements: int = 0
     garbage: bool = False  # some request for this (client, req_no) committed
     stored: bool = False  # persisted locally
     fetching: bool = False
@@ -177,7 +181,7 @@ class ClientRequest:
         self.fetching = True
         self.ticks_fetching = 0
         return Actions().send(
-            sorted(self.agreements),
+            mask_ids(self.agreements),
             pb.Msg(
                 type=pb.FetchRequest(
                     client_id=self.ack.client_id,
@@ -223,7 +227,7 @@ class ClientReqNo:
         self.valid_after_seq_no = valid_after_seq_no
         self.network_config = network_config
         self.committed = committed
-        self.non_null_voters: set = set()
+        self.non_null_voters: int = 0  # bitmask over node id
         self.requests: dict[bytes, ClientRequest] = {}  # all observed
         self.weak_requests: dict[bytes, ClientRequest] = {}  # f+1 correct
         self.strong_requests: dict[bytes, ClientRequest] = {}  # 2f+1
@@ -244,7 +248,7 @@ class ClientReqNo:
         self._weak_quorum = some_correct_quorum(network_config)
         self._strong_quorum = intersection_quorum(network_config)
         old_requests = self.requests
-        self.non_null_voters = set()
+        self.non_null_voters = 0
         self.requests = {}
         self.weak_requests = {}
         self.strong_requests = {}
@@ -253,7 +257,7 @@ class ClientReqNo:
         for digest in sorted(old_requests):
             old_req = old_requests[digest]
             for node_id in network_config.nodes:
-                if node_id in old_req.agreements:
+                if old_req.agreements & (1 << node_id):
                     self.apply_request_ack(node_id, old_req.ack, force=True)
             if old_req.stored:
                 new_req = self.client_req(old_req.ack)
@@ -313,13 +317,14 @@ class ClientReqNo:
         ``force`` marks the digest known-correct (weak quorum during
         three-phase commit, or epoch change)."""
         requests = self.requests
+        bit = 1 << source
         if ack.digest:
             key = ack.digest
-            if not force and source in self.non_null_voters:
+            if not force and self.non_null_voters & bit:
                 existing = requests.get(key)
-                if existing is None or source not in existing.agreements:
+                if existing is None or not existing.agreements & bit:
                     return  # second distinct non-null vote: ignored
-            self.non_null_voters.add(source)
+            self.non_null_voters |= bit
         else:
             key = _NULL
 
@@ -327,10 +332,10 @@ class ClientReqNo:
         if req is None:
             req = ClientRequest(ack=ack)
             requests[key] = req
-        agreements = req.agreements
-        agreements.add(source)
+        agreements = req.agreements | bit
+        req.agreements = agreements
 
-        count = len(agreements)
+        count = agreements.bit_count()
         if count < self._weak_quorum:
             return
         self.weak_requests[key] = req
@@ -797,6 +802,7 @@ class ClientTracker:
         RequestAck payloads."""
         clients_get = self.clients.get
         available_push = self.available_list.push_back
+        bit = 1 << source
         for msg in msgs:
             ack = msg.type
             client = clients_get(ack.client_id)
@@ -828,12 +834,12 @@ class ClientTracker:
             requests = crn.requests
             if digest:
                 key = digest
-                if source in crn.non_null_voters:
+                if crn.non_null_voters & bit:
                     existing = requests.get(key)
-                    if existing is None or source not in existing.agreements:
+                    if existing is None or not existing.agreements & bit:
                         continue  # second distinct non-null vote: ignored
                 else:
-                    crn.non_null_voters.add(source)
+                    crn.non_null_voters |= bit
             else:
                 key = _NULL
             weak = crn.weak_requests
@@ -842,9 +848,9 @@ class ClientTracker:
             if req is None:
                 req = ClientRequest(ack=ack)
                 requests[key] = req
-            agreements = req.agreements
-            agreements.add(source)
-            count = len(agreements)
+            agreements = req.agreements | bit
+            req.agreements = agreements
+            count = agreements.bit_count()
             if count >= crn._weak_quorum:
                 weak[key] = req
                 if count >= crn._strong_quorum:
@@ -898,7 +904,7 @@ class ClientTracker:
             return Actions()
         crn = client.req_no(req_no)
         req = crn.requests.get(digest or _NULL)
-        if req is None or self.my_config.id not in req.agreements:
+        if req is None or not req.agreements & (1 << self.my_config.id):
             return Actions()
         return Actions().forward_request(
             [source],
@@ -917,9 +923,9 @@ class ClientTracker:
             # We don't know this digest to be correct yet; drop (the weak
             # quorum will trigger a fetch if it becomes correct).
             return Actions()
-        if self.my_config.id in req.agreements:
+        if req.agreements & (1 << self.my_config.id):
             return Actions()  # we already hold + acked it
-        req.agreements.add(source)
+        req.agreements |= 1 << source
         return Actions().hash(
             request_hash_data(
                 pb.Request(

@@ -297,7 +297,7 @@ class EpochTarget:
                 for node in sources:
                     # Known-correct via f+1 qSets: force past the spam guard.
                     cr = self.client_tracker.ack(node, ack, force=True)
-                if cr is None or self.my_config.id in cr.agreements:
+                if cr is None or cr.agreements & (1 << self.my_config.id):
                     continue
                 fetch_pending = True
                 actions.concat(cr.fetch())

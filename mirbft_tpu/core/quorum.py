@@ -54,6 +54,18 @@ def seq_to_bucket(seq_no: int, config: pb.NetworkConfig) -> int:
 # ---------------------------------------------------------------------------
 
 
+def mask_ids(mask: int) -> list:
+    """Node IDs set in an int bitmask, ascending."""
+    ids = []
+    i = 0
+    while mask:
+        if mask & 1:
+            ids.append(i)
+        mask >>= 1
+        i += 1
+    return ids
+
+
 def make_bitmask(n_bits: int) -> bytearray:
     return bytearray((n_bits + 7) // 8)
 

@@ -79,7 +79,7 @@ class Sequence:
         self.state = SeqState.UNINITIALIZED
         self.q_entry: pb.QEntry | None = None
         # Set only when we own this sequence and proposed the batch ourselves;
-        # items expose .ack (pb.RequestAck) and .agreements (set of node IDs).
+        # items expose .ack (pb.RequestAck) and .agreements (node-id bitmask).
         self.client_requests: list | None = None
         self.batch: list | None = None  # [pb.RequestAck]
         self.outstanding_reqs: set | None = None  # digests not yet available
@@ -181,10 +181,11 @@ class Sequence:
         if self.owner == self.my_config.id:
             # Forward request data to nodes that haven't ACKed having it.
             for cr in self.client_requests or ():
+                agreements = cr.agreements
                 missing = [
                     node_id
                     for node_id in self.network_config.nodes
-                    if node_id not in cr.agreements
+                    if not agreements & (1 << node_id)
                 ]
                 actions.forward_request(missing, cr.ack)
             actions.send(

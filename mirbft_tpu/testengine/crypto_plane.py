@@ -162,11 +162,12 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         # identical either way, so determinism and recorded logs are
         # unaffected.
         self.min_device_rows = min_device_rows
-        # Overlap telemetry for the bench: launches that were in flight
-        # before any of their digests were demanded vs. flushes forced
-        # synchronously by a resolve miss, vs. host-hashed small waves.
+        # Overlap telemetry for the bench: device launches (always
+        # dispatched in advance of demand), resolve-miss host flushes,
+        # and the device/host/rescued digest split.
         self.overlapped_launches = 0
-        self.demand_launches = 0
+        # Resolve-miss flushes (host-hashed synchronously; see _flush).
+        self.demand_flushes = 0
         self.device_digests = 0
         self.host_digests = 0
         self.rescued_digests = 0
@@ -190,7 +191,7 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
             group = self._buckets.setdefault(bucket, [])
             group.append((index, msg))
             if len(group) >= self.rows_for(bucket):
-                self._launch(bucket, group, overlapped=True)
+                self._launch(bucket, group)
                 self._buckets[bucket] = []
             handles.append(_Lazy(self, index))
         self._dirty = True
@@ -205,7 +206,7 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         min_device_rows)."""
         if self._dirty:
             self._dirty = False
-            self._flush(overlapped=True)
+            self._flush(at_wave_boundary=True)
 
     def _host_hash(self, group: list) -> None:
         import hashlib
@@ -218,7 +219,7 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         self.flush_sizes.append(len(group))
         self.host_digests += len(group)
 
-    def _launch(self, bucket: int, group: list, overlapped: bool = False) -> None:
+    def _launch(self, bucket: int, group: list) -> None:
         import jax
 
         from ..ops.batching import pack_preimages
@@ -248,23 +249,22 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         for i in indices:
             self._chunk_of[i] = cid
         self.flush_sizes.append(len(indices))
-        if overlapped:
-            self.overlapped_launches += 1
-        else:
-            self.demand_launches += 1
+        self.overlapped_launches += 1
         self.device_digests += len(indices)
 
-    def _flush(self, overlapped: bool = False) -> None:
+    def _flush(self, at_wave_boundary: bool = False) -> None:
         """Flush every partially-filled bucket.  Proactive wave-boundary
         flushes go to the device when big enough to be worth a launch;
         small waves — and every demand-forced flush, which would block for
         a full round trip anyway — hash on the host (strictly faster than
         one tunnel RTT even for thousands of rows)."""
+        if not at_wave_boundary:
+            self.demand_flushes += 1
         for bucket, group in self._buckets.items():
             if not group:
                 continue
-            if overlapped and len(group) >= self.min_device_rows:
-                self._launch(bucket, group, overlapped=True)
+            if at_wave_boundary and len(group) >= self.min_device_rows:
+                self._launch(bucket, group)
             else:
                 self._host_hash(group)
             self._buckets[bucket] = []
@@ -289,10 +289,14 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         words, group, launch_s, launched_at = self._inflight.pop(cid)
         start = time.perf_counter()
         results = self._results
-        if start - launched_at < self.rescue_gap_s:
-            # Too soon for the tunnel round trip to have finished: the
-            # engine would stall waiting.  Recompute on the host (µs–ms)
-            # and let the device result drop.
+        try:
+            ready = words.is_ready()
+        except AttributeError:
+            ready = True  # non-jax arrays (tests): materialized already
+        if not ready and start - launched_at < self.rescue_gap_s:
+            # The round trip has not finished and too little wall time has
+            # passed to expect it soon: the engine would stall waiting.
+            # Recompute on the host (µs–ms) and let the device result drop.
             import hashlib
 
             for i, msg in group:

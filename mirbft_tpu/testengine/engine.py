@@ -24,10 +24,31 @@ processor contract, docs/Processor.md):
 
 from __future__ import annotations
 
+import contextlib
+import gc
 import hashlib
 import heapq
 import random
 from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def _gc_paused():
+    """Pause the cyclic collector for the duration of a drain loop.
+
+    The engine allocates millions of (almost entirely acyclic) events,
+    actions, and tracker records per run; generational GC repeatedly scans
+    the large live graph and costs ~40% of drain wall clock at ladder
+    scale.  The few real cycles (Recorder back-references) are collected
+    when the loop exits and the collector resumes."""
+    was_enabled = gc.isenabled()
+    if was_enabled:
+        gc.disable()
+    try:
+        yield
+    finally:
+        if was_enabled:
+            gc.enable()
 
 from .. import pb
 from ..core import actions as act
@@ -744,14 +765,15 @@ class Recorder:
 
     def drain_until(self, predicate, max_steps: int = 100_000) -> int:
         """Run until predicate(self) holds; returns events processed."""
-        for _ in range(max_steps):
-            if predicate(self):
-                return self.event_count
-            if not self.step():
-                raise AssertionError(
-                    f"event queue drained before condition "
-                    f"({self.event_count} events)"
-                )
+        with _gc_paused():
+            for _ in range(max_steps):
+                if predicate(self):
+                    return self.event_count
+                if not self.step():
+                    raise AssertionError(
+                        f"event queue drained before condition "
+                        f"({self.event_count} events)"
+                    )
         raise AssertionError(
             f"condition not reached after {max_steps} steps "
             f"({self.event_count} events)"
@@ -765,17 +787,18 @@ class Recorder:
         """Run until every client's requests commit at every live node;
         returns the number of events processed (the determinism anchor)."""
         check = True  # always evaluate on entry (drain may be a no-op)
-        for _ in range(max_steps):
-            if check or self._progress:
-                check = False
-                self._progress = False
-                if self.fully_committed():
-                    return self.event_count
-            if not self.step():
-                raise AssertionError(
-                    f"event queue drained before full commitment "
-                    f"({self.event_count} events)"
-                )
+        with _gc_paused():
+            for _ in range(max_steps):
+                if check or self._progress:
+                    check = False
+                    self._progress = False
+                    if self.fully_committed():
+                        return self.event_count
+                if not self.step():
+                    raise AssertionError(
+                        f"event queue drained before full commitment "
+                        f"({self.event_count} events)"
+                    )
         raise AssertionError(
             f"no full commitment after {max_steps} steps "
             f"({self.event_count} events)"

@@ -84,7 +84,7 @@ def test_owner_hash_result_sends_preprepare():
             self.agreements = agreements
 
     # Node 3 hasn't ACKed msg2: it must receive a forward.
-    crs = [CR(ACKS[0], {0, 1, 2, 3}), CR(ACKS[1], {0, 1, 2})]
+    crs = [CR(ACKS[0], 0b1111), CR(ACKS[1], 0b0111)]  # node-id bitmasks
     s.allocate_as_owner(crs)
     actions = s.apply_batch_hash_result(b"digest")
 
