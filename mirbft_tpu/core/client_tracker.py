@@ -34,7 +34,7 @@ Deliberate deviations from the reference:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .. import pb
 from .actions import Actions

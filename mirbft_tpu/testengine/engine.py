@@ -39,8 +39,8 @@ def _gc_paused():
     The engine allocates millions of (almost entirely acyclic) events,
     actions, and tracker records per run; generational GC repeatedly scans
     the large live graph and costs ~40% of drain wall clock at ladder
-    scale.  The few real cycles (Recorder back-references) are collected
-    when the loop exits and the collector resumes."""
+    scale.  The few real cycles (Recorder back-references) persist until
+    the resumed collector's next threshold-triggered pass."""
     was_enabled = gc.isenabled()
     if was_enabled:
         gc.disable()

@@ -7,6 +7,7 @@ request at ingress — dropping forged ones — while still reaching full
 commitment with identical chains across nodes.
 """
 
+import os
 import random
 
 import numpy as np
@@ -210,3 +211,93 @@ def test_signed_run_kernel_verifier_identical():
         count = r.drain_clients(max_steps=200000)
         runs[name] = (count, tuple(sorted(_chains(r).values())))
     assert runs["host"] == runs["kernel"]
+
+
+# -- Pallas kernels (ops/ed25519_pallas.py) ---------------------------------
+
+
+def test_pallas_field_ops_exact_vs_bigints():
+    """The slab field helpers (mul/sqr/add/sub/canonical) against host
+    bigints, in interpret mode on tiny (1, 8) tiles — fast enough for
+    every run; the full ladder is validated on real hardware by the
+    TPU-gated test below."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from mirbft_tpu.ops import ed25519_pallas as kp
+    from mirbft_tpu.ops.ed25519 import NLIMB, int_to_limbs, limbs_to_int
+
+    rng = random.Random(1)
+    vals = [0, 1, 19, host.P - 1, host.P, host.P + 1, 2**255 - 1]
+    vals += [rng.randrange(2**260) for _ in range(1)]
+    assert len(vals) == 8
+
+    def kernel(a_ref, b_ref, mul_ref, sqr_ref, add_ref, sub_ref, can_ref):
+        a = [a_ref[i, :, :] for i in range(NLIMB)]
+        b = [b_ref[i, :, :] for i in range(NLIMB)]
+        for i, v in enumerate(kp._mul(a, b)):
+            mul_ref[i, :, :] = v
+        for i, v in enumerate(kp._sqr(a)):
+            sqr_ref[i, :, :] = v
+        for i, v in enumerate(kp._add(a, b)):
+            add_ref[i, :, :] = v
+        for i, v in enumerate(kp._sub(a, b)):
+            sub_ref[i, :, :] = v
+        for i, v in enumerate(kp._canonical(kp._carry(a))):
+            can_ref[i, :, :] = v
+
+    def tile(ints):
+        arr = np.stack([int_to_limbs(v) for v in ints]).astype(np.int32)
+        return jnp.moveaxis(jnp.asarray(arr), 0, 1).reshape(NLIMB, 1, 8)
+
+    shape = jax.ShapeDtypeStruct((NLIMB, 1, 8), jnp.int32)
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=(shape,) * 5,
+        interpret=True,
+    )(tile(vals), tile(list(reversed(vals))))
+    mul, sqr, add, sub, can = (
+        np.moveaxis(np.asarray(o).reshape(NLIMB, 8), 0, 1) for o in outs
+    )
+    for i, (x, y) in enumerate(zip(vals, reversed(vals))):
+        assert limbs_to_int(mul[i]) % host.P == (x * y) % host.P
+        assert limbs_to_int(sqr[i]) % host.P == (x * x) % host.P
+        assert limbs_to_int(add[i]) % host.P == (x + y) % host.P
+        assert limbs_to_int(sub[i]) % host.P == (x - y) % host.P
+        assert limbs_to_int(can[i]) == x % host.P
+
+
+@pytest.mark.skipif(
+    not os.environ.get("MIRBFT_TPU_TPU_TESTS"),
+    reason="Mosaic compile of the full ladder takes minutes on first run; "
+    "set MIRBFT_TPU_TPU_TESTS=1 to run on a real TPU",
+)
+@pytest.mark.slow
+def test_pallas_verify_pipeline_matches_oracle():
+    """Full device pipeline (decompression + windowed ladder) vs the host
+    oracle on a mixed corpus, including host-structural rejects.
+
+    Mosaic has no CPU lowering and the test conftest pins JAX to the CPU
+    platform, so under pytest this skips unless a TPU backend is visible;
+    run it standalone (JAX_PLATFORMS unset) on real hardware.  The bench's
+    built-in validity cross-check covers the same path on every run."""
+    import jax
+
+    from mirbft_tpu.ops.ed25519_pallas import verify_batch_pallas
+
+    try:
+        tpu = jax.devices("tpu")[0]
+    except RuntimeError:
+        pytest.skip("no TPU backend available")
+
+    rng = random.Random(7)
+    pks, msgs, sigs, expect = _signed_corpus(61, rng)
+    pks += [b"\x00" * 31, host.public_key(b"\x01" * 32)]
+    msgs += [b"x", b"x"]
+    sigs += [b"\x00" * 64, b"\xff" * 64]  # bad pk len; S >= L
+    expect += [False, False]
+    with jax.default_device(tpu):
+        got = verify_batch_pallas(pks, msgs, sigs)
+    assert got.tolist() == expect
+    assert any(expect) and not all(expect)
