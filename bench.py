@@ -189,6 +189,80 @@ def ed25519_microbench(batch: int = 4096):
     return kernel_rate, host_rate
 
 
+RUNG3_NODES = 64
+RUNG3_CLIENTS = 1024
+RUNG3_REQS = 4
+
+
+def rung3_run():
+    """BASELINE ladder rung 3: 64 nodes f=21, 1024 Ed25519-signed clients,
+    ingress authentication on the Pallas verify pipeline.
+
+    Clients pre-sign their streams before the clock starts (client-side
+    work, not replica throughput); the signature plane's kernels must
+    already be warm (ed25519_microbench runs first and compiles the same
+    chunk shapes).  Returns (committed reqs/s, verify p99 ms, events,
+    verified count)."""
+    from mirbft_tpu import pb
+    from mirbft_tpu.crypto import ed25519_host as ed_host
+    from mirbft_tpu.testengine.engine import BasicRecorder
+    from mirbft_tpu.testengine.signing import (
+        SignaturePlane,
+        client_seed,
+        pallas_verifier,
+        signing_message,
+    )
+
+    client_ids = [RUNG3_NODES + i for i in range(RUNG3_CLIENTS)]
+    state = pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(RUNG3_NODES)),
+            f=(RUNG3_NODES - 1) // 3,
+            # Few buckets / short checkpoint interval: tames the
+            # O(buckets * n^2) heartbeat traffic at pod scale (same
+            # prescription as the engine's 128/256-node configs).
+            number_of_buckets=8,
+            checkpoint_interval=40,
+            max_epoch_length=400,
+        ),
+        clients=[
+            pb.NetworkClient(id=c, width=8, low_watermark=0)
+            for c in client_ids
+        ],
+    )
+
+    presigned = {}
+    for cid in client_ids:
+        seed = client_seed(cid)
+        pk = ed_host.public_key(seed)
+        for rn in range(RUNG3_REQS):
+            payload = b"%d:%d" % (cid, rn)
+            sig = ed_host.sign(seed, signing_message(cid, rn, payload))
+            presigned[(cid, rn)] = payload + sig + pk
+
+    plane = SignaturePlane(verifier=pallas_verifier)
+    start = time.perf_counter()
+    rec = BasicRecorder(
+        RUNG3_NODES,
+        RUNG3_CLIENTS,
+        RUNG3_REQS,
+        batch_size=200,
+        network_state=state,
+        signer=lambda cid, rn, _payload: presigned[(cid, rn)],
+        signature_plane=plane,
+        record=False,
+    )
+    events = rec.drain_clients(max_steps=50_000_000)
+    wall = time.perf_counter() - start
+    chains = {rec.node_states[n].app_chain for n in range(RUNG3_NODES)}
+    assert len(chains) == 1, "rung-3 nodes diverged!"
+    total = RUNG3_CLIENTS * RUNG3_REQS
+    assert all(rec.committed_at(n) == total for n in range(RUNG3_NODES))
+    flush_ms = sorted(1e3 * s for s in plane.flush_wall_s)
+    p99_ms = flush_ms[min(len(flush_ms) - 1, int(0.99 * len(flush_ms)))]
+    return total / wall, p99_ms, events, sum(plane.flush_sizes)
+
+
 def main():
     from mirbft_tpu.testengine.crypto_plane import AsyncKernelHashPlane
 
@@ -204,6 +278,9 @@ def main():
 
     xla_rate, pallas_rate, kernel_digest_rate, host_rate = kernel_microbench()
     ed_kernel_rate, ed_host_rate = ed25519_microbench()
+    # Rung 3 after the microbench: its verify chunks reuse the freshly
+    # compiled Pallas pipeline shapes, so the timed run is all steady state.
+    rung3_rate, rung3_p99, rung3_events, rung3_verified = rung3_run()
 
     total_reqs = CLIENTS * REQS_PER_CLIENT
     committed_rate = total_reqs / tpu_wall
@@ -247,6 +324,18 @@ def main():
                 "ed25519_vs_host_python": round(
                     ed_kernel_rate / ed_host_rate, 3
                 ),
+                # BASELINE ladder rung 3 (64 nodes f=21, 1024 signed
+                # clients, ingress auth on the Pallas verify pipeline).
+                "rung3_committed_reqs_per_sec": round(rung3_rate, 1),
+                "rung3_verify_p99_ms": round(rung3_p99, 2),
+                "rung3_config": (
+                    f"{RUNG3_NODES} nodes f={(RUNG3_NODES - 1) // 3}, "
+                    f"{RUNG3_CLIENTS} ed25519-signed clients, "
+                    f"{RUNG3_CLIENTS * RUNG3_REQS} reqs, batch_size=200, "
+                    "kernel ingress verification"
+                ),
+                "rung3_engine_events": rung3_events,
+                "rung3_verified_requests": rung3_verified,
             }
         )
     )

@@ -294,7 +294,10 @@ class ActiveEpoch:
             return Actions(), True
 
         actions = self.advance()
-        while seq_no > self.low_watermark():
+        # The epoch may legitimately hold no rows (e.g. freshly activated
+        # after a reconfiguration with its allocation already at the stop);
+        # there is then nothing to slide past.
+        while self.sequences and seq_no > self.low_watermark():
             self.sequences.pop(0)
         return actions, False
 

@@ -716,6 +716,23 @@ class ClientTracker:
                 client_state,
                 latest_states[client_state.id],
             )
+            # Re-seed the fresh available list: correct (weak-quorum)
+            # requests whose data we hold survived the reinitialize inside
+            # the window but their list membership did not — without this,
+            # sequences referencing requests disseminated *before* a
+            # reconfiguration or state transfer can never match their
+            # outstanding requests, and every post-reinitialize epoch
+            # starves into suspicion.
+            for req_no in range(
+                client.low_watermark, client.high_watermark + 1
+            ):
+                crn = client.req_no_map.get(req_no)
+                if crn is None or crn.committed is not None:
+                    continue
+                for digest in sorted(crn.weak_requests):
+                    cr = crn.weak_requests[digest]
+                    if cr.stored and not cr.garbage:
+                        self.available_list.push_back(cr)
             self.advance_ready(client)
 
         old_buffers = self.msg_buffers

@@ -105,12 +105,11 @@ class CommitState:
     # -- lifecycle -----------------------------------------------------------
 
     def reinitialize(self) -> Actions:
-        last_c = second_to_last_c = None
+        last_c = None
         last_t = None
 
         def on_c(c_entry):
-            nonlocal last_c, second_to_last_c
-            second_to_last_c = last_c
+            nonlocal last_c
             last_c = c_entry
 
         def on_t(t_entry):
@@ -119,17 +118,18 @@ class CommitState:
 
         self.persisted.iterate({pb.CEntry: on_c, pb.TEntry: on_t})
 
-        if (
-            second_to_last_c is None
-            or not second_to_last_c.network_state.pending_reconfigurations
-        ):
-            self.active_state = last_c.network_state
-            self.low_watermark = last_c.seq_no
-        else:
-            # The previous checkpoint carried reconfigurations: the active
-            # state is still the pre-reconfig one until the network quiesces.
-            self.active_state = second_to_last_c.network_state
-            self.low_watermark = second_to_last_c.seq_no
+        # The newest checkpoint is authoritative (reference:
+        # commitstate.go:85-100).  In particular, a checkpoint whose
+        # predecessor carried pending reconfigurations already embodies the
+        # *applied* new configuration (next_network_config ran when it was
+        # computed), so every tracker must reinitialize into it — an
+        # earlier revision rolled back to the pre-reconfig state here
+        # "until the network quiesces", which silently stranded the epoch
+        # tracker and member set on the old node set forever (the
+        # activation checkpoint was then recomputed and the first-sight
+        # guard suppressed the second activation).
+        self.active_state = last_c.network_state
+        self.low_watermark = last_c.seq_no
 
         ci = self.active_state.config.checkpoint_interval
         if not self.active_state.pending_reconfigurations:

@@ -81,6 +81,10 @@ class EpochTarget:
         self.state = TargetState.PREPENDING
         self.state_ticks = 0
         self.starting_seq_no = 0
+        # hash-preimage bytes -> digest: computed-digest memo for the ack
+        # fan-in (see apply_epoch_change_ack); scope is this target's
+        # lifetime.
+        self._ack_digest_memo: dict[bytes, bytes] = {}
         # origin node -> EpochChangeCert (digest variants + ACKs)
         self.changes: dict[int, EpochChangeCert] = {}
         # origin node -> ParsedEpochChange with a strong cert
@@ -137,12 +141,27 @@ class EpochTarget:
     def apply_epoch_change_ack(
         self, source: int, origin: int, msg: pb.EpochChange
     ) -> Actions:
-        # ACK certification is over the *digest* of the change; request the
-        # hash from the executor, result returns via apply_epoch_change_digest.
+        # The ack scheme is O(n^3) messages per epoch change.  The digest
+        # of one origin's change is independent of who acked it; once
+        # computed (via the executor round trip below), further acks of a
+        # byte-identical change apply synchronously — near O(n^2)
+        # processing.  The memo is keyed by the hash preimage — a pure
+        # function of the message value — so live runs and event-log
+        # replays take identical paths (an object-identity key would
+        # diverge under replay).  Acks keep accumulating even after a
+        # strong cert forms: an equivocating origin's *other* digest
+        # variants may still need their f+1 for new-epoch verification.
         from .preimage import epoch_change_hash_data
 
+        data = epoch_change_hash_data(msg)
+        key = b"".join(data)
+        digest = self._ack_digest_memo.get(key)
+        if digest is not None:
+            return self._apply_change_digest(source, origin, msg, digest)
+        # ACK certification is over the *digest* of the change; request the
+        # hash from the executor, result returns via apply_epoch_change_digest.
         return Actions().hash(
-            epoch_change_hash_data(msg),
+            data,
             pb.HashResult(
                 digest=b"",
                 type=pb.HashOriginEpochChange(
@@ -154,13 +173,24 @@ class EpochTarget:
     def apply_epoch_change_digest(
         self, origin_info: pb.HashOriginEpochChange, digest: bytes
     ) -> Actions:
-        origin = origin_info.origin
-        source = origin_info.source
+        msg = origin_info.epoch_change
+        from .preimage import epoch_change_hash_data
+
+        key = b"".join(epoch_change_hash_data(msg))
+        if key not in self._ack_digest_memo:
+            self._ack_digest_memo[key] = digest
+        return self._apply_change_digest(
+            origin_info.source, origin_info.origin, msg, digest
+        )
+
+    def _apply_change_digest(
+        self, source: int, origin: int, msg: pb.EpochChange, digest: bytes
+    ) -> Actions:
         cert = self.changes.get(origin)
         if cert is None:
             cert = EpochChangeCert(network_config=self.network_config)
             self.changes[origin] = cert
-        cert.add_msg(source, origin_info.epoch_change, digest)
+        cert.add_msg(source, msg, digest)
 
         if cert.strong_cert is None or origin in self.strong_changes:
             return Actions()

@@ -52,6 +52,11 @@ class StateMachine:
         self.batch_tracker: BatchTracker | None = None
         self.epoch_tracker: EpochTracker | None = None
         self._loaded_reqs: list = []
+        # Active member set; messages from non-members (e.g. a node removed
+        # by reconfiguration that has not yet stopped sending) are dropped
+        # at ingress — per-source buffers and quorum maps are keyed by the
+        # active config and must never see foreign ids.
+        self._members: frozenset = frozenset()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -101,6 +106,9 @@ class StateMachine:
         self._loaded_reqs = []
 
         actions.concat(self.commit_state.reinitialize())
+        self._members = frozenset(
+            self.commit_state.active_state.config.nodes
+        )
         self.checkpoint_tracker.reinitialize()
         self.batch_tracker.reinitialize()
         return actions.concat(self.epoch_tracker.reinitialize())
@@ -168,6 +176,8 @@ class StateMachine:
                     f"cannot apply {type(inner).__name__} before initialization"
                 )
             if inner_type is pb.EventStep:
+                if inner.source not in self._members:
+                    return _EMPTY_ACTIONS  # non-member (e.g. removed node)
                 stepped = self._step(inner.source, inner.msg)
                 if stepped is not _EMPTY_ACTIONS:
                     actions.concat(stepped)
@@ -177,6 +187,8 @@ class StateMachine:
                 # dispatch is inlined: acks dominate batch contents at scale
                 # and their handler never emits actions.
                 source = inner.source
+                if source not in self._members:
+                    return _EMPTY_ACTIONS  # non-member (e.g. removed node)
                 msgs = inner.msgs
                 ack_cls = pb.RequestAck
                 step = self._step

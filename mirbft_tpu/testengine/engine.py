@@ -168,6 +168,7 @@ class Recorder:
         network_state=None,
         checkpoint_certs=None,
         record=True,
+        deferred_nodes=(),
     ):
         self.params = params or RuntimeParameters()
         self.rng = random.Random(seed)
@@ -212,12 +213,22 @@ class Recorder:
                 f"network_state declares {len(client_ids)} clients, "
                 f"client_count={client_count}"
             )
-            assert list(network_state.config.nodes) == list(
-                range(node_count)
-            ), (
+            # The simulated universe may be a superset of the configured
+            # member set, but only by the explicitly deferred nodes
+            # (replicas that join later via a node-set reconfiguration,
+            # see provision_node) — a live non-member would hang at drain
+            # instead of failing fast.
+            members = set(network_state.config.nodes)
+            assert members <= set(range(node_count)), (
                 f"network_state declares nodes "
                 f"{network_state.config.nodes}, engine simulates "
                 f"0..{node_count - 1}"
+            )
+            assert set(range(node_count)) - members <= set(
+                deferred_nodes
+            ), (
+                f"nodes {sorted(set(range(node_count)) - members)} are "
+                f"simulated but neither configured members nor deferred"
             )
         else:
             client_ids = [node_count + i for i in range(client_count)]
@@ -257,7 +268,17 @@ class Recorder:
 
         self.machines: dict[int, StateMachine] = {}
         self.node_states: dict[int, NodeState] = {}
+        # Deferred nodes are part of the simulated universe but not yet
+        # provisioned (they join later via a node-set reconfiguration +
+        # provision_node); until then they behave like crashed nodes.
+        self.deferred_nodes = set(deferred_nodes)
         for node in range(node_count):
+            if node in self.deferred_nodes:
+                state = NodeState()
+                state.crashed = True
+                self.node_states[node] = state
+                self.machines[node] = StateMachine()
+                continue
             self._start_node(node, at_time=0)
             self._schedule(self.params.tick_interval, node, _tick_event())
 
@@ -346,7 +367,113 @@ class Recorder:
         # Mangler protocol: each mangler maps one candidate to None (drop),
         # a (when, node, event) tuple, or a list of tuples (duplication);
         # manglers fold left over the candidate set.
-        candidates = [(when, node, event)]
+        for w, n, e in self._mangle([(when, node, event)]):
+            heapq.heappush(self._queue, (w, self._seq, n, e))
+            self._seq += 1
+
+    def _schedule_frame_mangled(
+        self, delay: int, source: int, target: int, msgs: list
+    ) -> None:
+        """Fold each msg of a frame through the manglers as its own
+        EventStep candidate (per-msg fault-injection semantics), then
+        re-coalesce survivors that share a delivery instant into batch
+        events."""
+        state = self.node_states.get(target)
+        if state is not None and state.crashed:
+            return  # a down node loses its inbound traffic
+        when = self.now + delay
+        survivors: list = []
+        for msg in msgs:
+            survivors.extend(
+                self._mangle(
+                    [
+                        (
+                            when,
+                            target,
+                            pb.StateEvent(
+                                type=pb.EventStep(source=source, msg=msg)
+                            ),
+                        )
+                    ]
+                )
+            )
+        merged: dict = {}
+        for w, n, e in survivors:
+            merged.setdefault((w, n), []).append(e)
+        for (w, n), events in merged.items():
+            if len(events) == 1:
+                event = events[0]
+            else:
+                event = pb.StateEvent(
+                    type=pb.EventStepBatch(
+                        source=source,
+                        msgs=[e.type.msg for e in events],
+                    )
+                )
+            heapq.heappush(self._queue, (w, self._seq, n, event))
+            self._seq += 1
+
+    def provision_node(
+        self, node: int, from_node: int, seq_no: int, delay: int
+    ) -> None:
+        """Provision a (deferred or crashed) node from another node's
+        stable checkpoint and schedule its boot — the operator-side half of
+        a node-set reconfiguration: the new replica starts from a snapshot
+        whose network state already includes it (reference seam:
+        commitstate.go:192-226; the reference admits this path 'does not
+        entirely work', README.md:35 — here it is driven end to end).
+
+        The synthesized WAL is the bootstrap pair (CEntry at the snapshot +
+        FEntry for the snapshot's epoch); the app state (hash chain +
+        per-client commit sets) is adopted exactly as a completed state
+        transfer would."""
+        source_state = self.node_states[from_node]
+        stored = source_state.checkpoints.get(seq_no)
+        assert stored is not None, (
+            f"node {from_node} has no checkpoint at {seq_no}"
+        )
+        value, network_state, snapshot = stored
+        assert node in network_state.config.nodes, (
+            f"checkpoint at {seq_no} does not configure node {node}"
+        )
+        # The epoch active at the source: the new node's FEntry ends the
+        # previous epoch, so its reinitialize runs the normal after-epoch-
+        # change path and it integrates at the next epoch rollover.
+        current = self.machines[from_node].epoch_tracker.current_epoch
+        epoch_config = pb.EpochConfig(
+            number=current.number,
+            leaders=list(network_state.config.nodes),
+            planned_expiration=0,
+        )
+
+        state = self.node_states[node]
+        state.wal = [
+            (
+                1,
+                pb.Persistent(
+                    type=pb.CEntry(
+                        seq_no=seq_no,
+                        checkpoint_value=value,
+                        network_state=network_state,
+                    )
+                ),
+            ),
+            (2, pb.Persistent(type=pb.FEntry(ends_epoch_config=epoch_config))),
+        ]
+        state.reqstore = {}
+        state.app_chain = value
+        state.last_committed = seq_no
+        for cid, req_nos in snapshot.items():
+            mine = self.clients[cid].committed_by_node.setdefault(node, set())
+            self._committed_counts[node] += len(req_nos - mine)
+            mine |= req_nos
+        self._progress = True
+        self.deferred_nodes.discard(node)
+        self.schedule_restart(node, delay)
+
+    def _mangle(self, candidates: list) -> list:
+        """Fold candidate (when, node, event) tuples through every mangler
+        (None = drop, tuple = reschedule, list = duplicate)."""
         for mangler in self.manglers:
             folded = []
             for w, n, e in candidates:
@@ -358,9 +485,7 @@ class Recorder:
                 else:
                     folded.append(verdict)
             candidates = folded
-        for w, n, e in candidates:
-            heapq.heappush(self._queue, (w, self._seq, n, e))
-            self._seq += 1
+        return candidates
 
     def schedule_restart(self, node: int, delay: int) -> None:
         """Schedule a node (possibly crashed) to boot from its durable state
@@ -504,85 +629,64 @@ class Recorder:
             )
 
         send_delay = persist_delay + self.params.link_latency
-        if self.manglers:
-            # Per-msg scheduling: mangler matchers (drop/jitter/duplicate by
-            # msg type) operate on individual EventStep events.
-            for send in actions.sends:
-                if self.checkpoint_certs is not None:
-                    self.checkpoint_certs.observe(node, send.msg)
-                for target in send.targets:
-                    self._schedule(
-                        send_delay,
-                        target,
-                        pb.StateEvent(
-                            type=pb.EventStep(source=node, msg=send.msg)
-                        ),
-                    )
-            for fwd in actions.forward_requests:
-                stored = state.reqstore.get(fwd.request_ack.digest)
-                if stored is None:
-                    continue
-                _ack, data = stored
-                msg = pb.Msg(
-                    type=pb.ForwardRequest(
-                        request_ack=fwd.request_ack, request_data=data
-                    )
+        # Coalesce this pass's sends into one frame per distinct target
+        # set — the transport-level batching that collapses the n^2
+        # per-request ack fan-out into per-(source,target) deliveries.
+        # All targets of a group share one event object.  A target
+        # appearing in several groups receives the groups as separate
+        # frames in emission order; relative reordering of msgs across
+        # groups is fine (the network is unordered by assumption) and
+        # deterministic (insertion-ordered dicts).
+        groups: dict[tuple, list] = {}
+        observe = (
+            self.checkpoint_certs.observe
+            if self.checkpoint_certs is not None
+            else None
+        )
+        last_targets = None  # sends overwhelmingly share one list object
+        last_key = None
+        for send in actions.sends:
+            if observe is not None:
+                observe(node, send.msg)
+            targets = send.targets
+            if targets is last_targets:
+                key = last_key
+            else:
+                key = tuple(targets)
+                last_targets, last_key = targets, key
+            frame = groups.get(key)
+            if frame is None:
+                groups[key] = [send.msg]
+            else:
+                frame.append(send.msg)
+        for fwd in actions.forward_requests:
+            stored = state.reqstore.get(fwd.request_ack.digest)
+            if stored is None:
+                continue
+            _ack, data = stored
+            msg = pb.Msg(
+                type=pb.ForwardRequest(
+                    request_ack=fwd.request_ack, request_data=data
                 )
-                for target in fwd.targets:
-                    self._schedule(
-                        send_delay,
-                        target,
-                        pb.StateEvent(
-                            type=pb.EventStep(source=node, msg=msg)
-                        ),
+            )
+            key = tuple(fwd.targets)
+            frame = groups.get(key)
+            if frame is None:
+                groups[key] = [msg]
+            else:
+                frame.append(msg)
+        if self.manglers:
+            # Manglers keep their per-msg semantics: each inner msg folds
+            # through the rules as its own EventStep candidate (so
+            # msg-type/percent matchers behave exactly as before), and the
+            # survivors that still share a delivery instant re-coalesce
+            # into frames.
+            for targets, msgs in groups.items():
+                for target in targets:
+                    self._schedule_frame_mangled(
+                        send_delay, node, target, msgs
                     )
         else:
-            # Coalesce this pass's sends into one frame per distinct target
-            # set — the transport-level batching that collapses the n^2
-            # per-request ack fan-out into per-(source,target) deliveries.
-            # All targets of a group share one event object.  A target
-            # appearing in several groups receives the groups as separate
-            # frames in emission order; relative reordering of msgs across
-            # groups is fine (the network is unordered by assumption) and
-            # deterministic (insertion-ordered dicts).
-            groups: dict[tuple, list] = {}
-            observe = (
-                self.checkpoint_certs.observe
-                if self.checkpoint_certs is not None
-                else None
-            )
-            last_targets = None  # sends overwhelmingly share one list object
-            last_key = None
-            for send in actions.sends:
-                if observe is not None:
-                    observe(node, send.msg)
-                targets = send.targets
-                if targets is last_targets:
-                    key = last_key
-                else:
-                    key = tuple(targets)
-                    last_targets, last_key = targets, key
-                frame = groups.get(key)
-                if frame is None:
-                    groups[key] = [send.msg]
-                else:
-                    frame.append(send.msg)
-            for fwd in actions.forward_requests:
-                stored = state.reqstore.get(fwd.request_ack.digest)
-                if stored is None:
-                    continue
-                _ack, data = stored
-                msg = pb.Msg(
-                    type=pb.ForwardRequest(
-                        request_ack=fwd.request_ack, request_data=data
-                    )
-                )
-                key = tuple(fwd.targets)
-                frame = groups.get(key)
-                if frame is None:
-                    groups[key] = [msg]
-                else:
-                    frame.append(msg)
             for targets, msgs in groups.items():
                 if len(msgs) == 1:
                     event = pb.StateEvent(

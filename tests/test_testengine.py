@@ -84,7 +84,7 @@ def test_reference_anchor_scale():
     # (recorder_test.go:69-71).
     r = BasicRecorder(node_count=4, client_count=4, reqs_per_client=200)
     count = r.drain_clients(max_steps=500000)
-    assert count == 6292  # regression anchor for our engine
+    assert count == 6276  # regression anchor for our engine
     assert len(set(chains(r).values())) == 1
 
 
@@ -160,7 +160,7 @@ def test_sixteen_node_anchor():
     r = BasicRecorder(node_count=16, client_count=64, reqs_per_client=25,
                       batch_size=200)
     count = r.drain_clients(max_steps=1_000_000)
-    assert count == 27920  # regression anchor for our engine
+    assert count == 27904  # regression anchor for our engine
     assert len(set(chains(r).values())) == 1
     assert all(r.committed_at(n) == 16 * 100 for n in range(16))
 
@@ -172,23 +172,20 @@ def test_sixty_four_node_network():
     r = BasicRecorder(node_count=64, client_count=4, reqs_per_client=3,
                       batch_size=10)
     count = r.drain_clients(max_steps=2_000_000)
-    assert count == 38662  # regression anchor for our engine
+    assert count == 38598  # regression anchor for our engine
     assert len(set(chains(r).values())) == 1
     assert all(r.committed_at(n) == 12 for n in range(64))
 
 
-@pytest.mark.skipif(
-    not os.environ.get("MIRBFT_TPU_HEAVY"),
-    reason="~3 min: epoch change is O(n^3) messages at 128 nodes; "
-    "set MIRBFT_TPU_HEAVY=1 to run",
-)
 @pytest.mark.slow
 def test_one_hundred_twenty_eight_node_wan():
     """BASELINE rung-4 node count under WAN jitter: 128 nodes, 4 leader
     buckets (explicit network_state tames the O(buckets*n^2) heartbeat
     traffic), 30ms jitter on every delivery.  The epoch-change ack scheme
-    alone is ~n^3 = 2M messages; measured ~4.4M events to full
-    commitment with one chain."""
+    is ~n^3 messages; the value-keyed digest memo and post-strong-cert
+    skip (epoch_target.apply_epoch_change_ack) plus frame coalescing keep
+    the run under a minute in the default suite (was HEAVY-gated at ~3
+    min before round 4)."""
     from mirbft_tpu.testengine.manglers import is_step, rule
 
     nodes = 128
@@ -316,6 +313,14 @@ def test_combined_storm_crash_and_transfer():
             break
         r.step()
     r.restart(2)
+    # The restart enqueues node 2's boot; make sure it actually boots even
+    # if the survivors already hold full commitment (drain_clients may
+    # otherwise return before the queued Initialize applies).
+    r.drain_until(
+        lambda rr: rr.machines[2].epoch_tracker is not None
+        and rr.machines[2].epoch_tracker.current_epoch is not None,
+        max_steps=600000,
+    )
     r.drain_clients(max_steps=600000)
     # The survivors went through at least one epoch change.
     epochs = {
