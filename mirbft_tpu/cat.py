@@ -139,6 +139,79 @@ def cmd_status(events, args, out) -> None:
         print(status.pretty() if args.pretty else status.to_json(), file=out)
 
 
+def render_actions(actions, out, max_bytes: int = 16) -> None:
+    """Textual rendering of one event's emitted Actions (the reference CLI
+    prints aggregated actions during replay, mircat/main.go:419-503)."""
+    if actions.is_empty():
+        print("  (no actions)", file=out)
+        return
+    for send in actions.sends:
+        print(
+            f"  send {list(send.targets)}: {text(send.msg.type, max_bytes)}",
+            file=out,
+        )
+    for fwd in actions.forward_requests:
+        print(
+            f"  forward {list(fwd.targets)}: "
+            f"{text(fwd.request_ack, max_bytes)}",
+            file=out,
+        )
+    for hr in actions.hashes:
+        size = sum(len(chunk) for chunk in hr.data)
+        print(
+            f"  hash {size}B -> {text(hr.origin.type, 8)}",
+            file=out,
+        )
+    for write in actions.write_ahead:
+        if write.append is not None:
+            print(
+                f"  persist [{write.append.index}] "
+                f"{text(write.append.data.type, max_bytes)}",
+                file=out,
+            )
+        else:
+            print(f"  truncate < {write.truncate}", file=out)
+    for store in actions.store_requests:
+        print(f"  store {text(store.request_ack, max_bytes)}", file=out)
+    for commit in actions.commits:
+        if commit.batch is not None:
+            print(f"  commit {text(commit.batch, max_bytes)}", file=out)
+        else:
+            print(
+                f"  checkpoint seq={commit.checkpoint.seq_no}",
+                file=out,
+            )
+    if actions.state_transfer is not None:
+        print(
+            f"  state-transfer seq={actions.state_transfer.seq_no}",
+            file=out,
+        )
+
+
+def cmd_actions(events, args, out) -> None:
+    """Replay the log and print the Actions the state machine emitted at
+    the chosen event indices."""
+    wanted = set(args.actions_at)
+    player = Player(events)
+    limit = max(wanted) + 1
+    while player.position < limit:
+        recorded = player.step()
+        if recorded is None:
+            break
+        index = player.position - 1
+        if index not in wanted:
+            continue
+        print(
+            f"=== actions @ event {index} (node {recorded.node_id}, "
+            f"{event_kind(recorded.state_event)}) ===",
+            file=out,
+        )
+        render_actions(player.nodes[recorded.node_id].actions, out)
+    missing = [i for i in sorted(wanted) if i >= len(events)]
+    for i in missing:
+        print(f"# event {i} is beyond the log ({len(events)} events)", file=out)
+
+
 def cmd_timing(events, out) -> None:
     """Replay the log and report per-node state-machine execution time
     (the reference CLI's per-node report, mircat/main.go:497-499)."""
@@ -206,6 +279,9 @@ def main(argv=None, out=sys.stdout) -> int:
     parser.add_argument("--status-at", type=int, default=None,
                         help="replay to this index and print every node's status "
                              "(-1 = end of log)")
+    parser.add_argument("--actions-at", type=int, action="append", default=[],
+                        help="replay and print the Actions emitted at this "
+                             "event index (repeatable)")
     parser.add_argument("--timing", action="store_true",
                         help="replay and report per-node state-machine "
                              "execution time")
@@ -223,6 +299,8 @@ def main(argv=None, out=sys.stdout) -> int:
     events = read_log(args.log)
     if args.summary:
         cmd_summary(events, out)
+    elif args.actions_at:
+        cmd_actions(events, args, out)
     elif args.timing:
         cmd_timing(events, out)
     elif args.status_at is not None:

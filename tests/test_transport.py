@@ -216,3 +216,205 @@ def test_four_node_consensus_over_tcp():
         for replica in replicas:
             replica.stop()
     assert all(r.node.exit_error is None for r in replicas)
+
+
+# -- transport failure paths (VERDICT r3 item 9) -----------------------------
+
+
+def _rebind(node_id, addr, timeout=10.0):
+    """Re-create a transport on a just-closed address (the OS may hold the
+    port briefly; retry until it frees)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return TcpTransport(node_id, host=addr[0], port=addr[1])
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
+def test_send_to_down_peer_drops_silently():
+    """A send to a registered peer with nothing listening is dropped (the
+    Link contract is fire-and-forget; retransmit ticks recover)."""
+    t = TcpTransport(0)
+    try:
+        # Grab a port that is then closed again: nothing listens there.
+        probe = TcpTransport(1)
+        dead_addr = probe.address
+        probe.close()
+        time.sleep(0.05)
+        t.connect(1, dead_addr)
+        t.link().send(1, pb.Msg(type=pb.Suspect(epoch=3)))  # must not raise
+        assert 1 not in t._conns  # no connection was cached
+    finally:
+        t.close()
+
+
+def test_peer_death_mid_stream_and_reconnect():
+    """Killing the receiving transport mid-stream drops frames; a new
+    transport on the same port is reconnected to lazily and receives."""
+    received = []
+
+    class _Sink:
+        def step(self, source, msg):
+            received.append((source, type(msg.type).__name__))
+
+    sender = TcpTransport(0)
+    receiver = TcpTransport(1)
+    try:
+        sender.connect(1, receiver.address)
+        receiver.serve(_Sink())
+        sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=1)))
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert received == [(0, "Suspect")]
+
+        # Peer dies: the established connection breaks.  Sends during the
+        # outage drop (possibly after one failed write flushes the stale
+        # connection).
+        addr = receiver.address
+        receiver.close()
+        time.sleep(0.05)
+        for _ in range(3):
+            sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=2)))
+            time.sleep(0.02)
+
+        # Peer restarts on the same address: the next send reconnects.
+        receiver = _rebind(1, addr)
+        receiver.serve(_Sink())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=9)))
+            if any(m == (0, "Suspect") and len(received) > 1 for m in received):
+                break
+            time.sleep(0.05)
+        assert len(received) > 1, "no delivery after peer restart"
+    finally:
+        sender.close()
+        receiver.close()
+
+
+def test_partial_and_corrupt_frames():
+    """Dribbled frames are reassembled; truncated frames die with their
+    connection; oversized or zero length headers drop the connection; a
+    well-formed frame with garbage payload is dropped without crashing."""
+    import socket as socketlib
+    import struct
+
+    from mirbft_tpu import wire
+
+    received = []
+
+    class _Sink:
+        def step(self, source, msg):
+            received.append((source, type(msg.type).__name__))
+
+    t = TcpTransport(7)
+    t.serve(_Sink())
+    try:
+        payload = wire.encode_varint(3) + pb.encode(
+            pb.Msg(type=pb.Suspect(epoch=5))
+        )
+        frame = struct.pack("<I", len(payload)) + payload
+
+        # 1. One byte at a time: must reassemble.
+        s = socketlib.create_connection(t.address)
+        for b in frame:
+            s.sendall(bytes([b]))
+            time.sleep(0.001)
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert received == [(3, "Suspect")]
+
+        # 2. Truncated frame then close: dropped, no delivery, no crash.
+        s2 = socketlib.create_connection(t.address)
+        s2.sendall(frame[: len(frame) // 2])
+        s2.close()
+
+        # 3. Oversized length header: connection dropped immediately.
+        s3 = socketlib.create_connection(t.address)
+        s3.sendall(struct.pack("<I", 1 << 31))
+        # 4. Garbage payload in a well-formed frame: dropped.
+        s4 = socketlib.create_connection(t.address)
+        junk = b"\xff" * 40
+        s4.sendall(struct.pack("<I", len(junk)) + junk)
+        time.sleep(0.2)
+        assert received == [(3, "Suspect")]  # nothing else got through
+
+        # The transport still works after all of that.
+        s5 = socketlib.create_connection(t.address)
+        s5.sendall(frame)
+        deadline = time.monotonic() + 5
+        while len(received) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert received[-1] == (3, "Suspect")
+        for sock in (s, s3, s4, s5):
+            sock.close()
+    finally:
+        t.close()
+
+
+def test_consensus_survives_transport_kill_and_restore():
+    """A replica's entire transport dies mid-run and is replaced (same
+    port); the network keeps committing and the revived replica converges
+    (VERDICT r3 item 9's liveness gate)."""
+    state = standard_initial_network_state(4, [9])
+    registry = {}
+    replicas = [_TcpReplica(i, state, registry) for i in range(4)]
+    try:
+        for a in replicas:
+            for b in replicas:
+                if a is not b:
+                    a.transport.connect(b.node.config.id, b.transport.address)
+        for replica in replicas:
+            replica.start()
+
+        requests = [
+            pb.Request(client_id=9, req_no=i, data=b"%d" % i)
+            for i in range(10)
+        ]
+        for request in requests[:5]:
+            for replica in replicas:
+                replica.node.propose(request)
+        time.sleep(0.3)
+
+        # Node 3's transport dies wholesale and is replaced on the same
+        # port; peers reconnect lazily on their next sends.
+        victim = replicas[3]
+        addr = victim.transport.address
+        victim.transport.close()
+        time.sleep(0.1)
+        victim.transport = _rebind(3, addr)
+        victim.transport.serve(victim.node)
+        for b in replicas:
+            if b is not victim:
+                victim.transport.connect(b.node.config.id, b.transport.address)
+        # The processor holds the old link object; swap in the new one.
+        victim.processor.link = victim.transport.link()
+
+        for request in requests[5:]:
+            for replica in replicas:
+                replica.node.propose(request)
+
+        expected = {(9, r.req_no) for r in requests}
+        deadline = time.monotonic() + 120
+        while True:
+            full = [
+                r for r in replicas
+                if expected <= {(c, n) for c, n in r.app_log.commits}
+            ]
+            chains = {r.app_log.chain for r in replicas}
+            if full and len(chains) == 1 and b"" not in chains:
+                break
+            assert time.monotonic() < deadline, (
+                f"no convergence after transport restore: "
+                f"{[len(set(r.app_log.commits)) for r in replicas]}"
+            )
+            time.sleep(0.05)
+    finally:
+        for replica in replicas:
+            replica.stop()
+    assert all(r.node.exit_error is None for r in replicas)
