@@ -42,6 +42,11 @@ class TcpTransport:
         # _lock guards only the maps; sends serialize per peer so one
         # stalled peer cannot block broadcast to the others.
         self._conns: dict[int, tuple[socket.socket, threading.Lock]] = {}
+        # Accepted inbound sockets.  close() must shutdown+close these too:
+        # leaving them open keeps their read threads blocked in recv, keeps
+        # the port occupied past a rebind, and — worse — lets a "closed"
+        # transport keep delivering frames to its sink.
+        self._accepted: set[socket.socket] = set()
         self._lock = threading.Lock()
         self._closed = threading.Event()
 
@@ -120,6 +125,11 @@ class TcpTransport:
                 conn, _addr = self._server.accept()
             except OSError:
                 return  # closed
+            with self._lock:
+                if self._closed.is_set():
+                    conn.close()
+                    return
+                self._accepted.add(conn)
             threading.Thread(
                 target=self._read_loop,
                 args=(conn,),
@@ -141,6 +151,8 @@ class TcpTransport:
                     return
                 self._deliver(payload)
         finally:
+            with self._lock:
+                self._accepted.discard(conn)
             conn.close()
 
     @staticmethod
@@ -157,6 +169,8 @@ class TcpTransport:
         return buf
 
     def _deliver(self, payload: bytes) -> None:
+        if self._closed.is_set():
+            return  # closed transport must never deliver
         node = self._node
         if node is None:
             return  # not serving yet: dropped
@@ -180,5 +194,15 @@ class TcpTransport:
         with self._lock:
             conns = [conn for conn, _lock in self._conns.values()]
             self._conns.clear()
+            accepted = list(self._accepted)
+            self._accepted.clear()
         for conn in conns:
+            conn.close()
+        for conn in accepted:
+            # shutdown unblocks the read thread's recv immediately; close
+            # alone would leave it blocked and the port ESTABLISHED.
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             conn.close()
