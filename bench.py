@@ -32,6 +32,19 @@ import time
 
 import numpy as np
 
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: the Pallas Ed25519 ladder alone
+    is minutes of Mosaic compile per shape — across bench runs (and test
+    sessions) each shape should compile once per machine, ever."""
+    import os
+
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 CHAIN_BATCH = 32768
 CHAIN_ITERS = 4096  # 134M compressions/launch: compute well above RTT noise
 CHAIN_REPS = 4
@@ -207,9 +220,9 @@ def rung3_run():
     from mirbft_tpu.crypto import ed25519_host as ed_host
     from mirbft_tpu.testengine.engine import BasicRecorder
     from mirbft_tpu.testengine.signing import (
-        SignaturePlane,
+        AsyncSignaturePlane,
         client_seed,
-        pallas_verifier,
+        register_pk,
         signing_message,
     )
 
@@ -235,12 +248,31 @@ def rung3_run():
     for cid in client_ids:
         seed = client_seed(cid)
         pk = ed_host.public_key(seed)
+        # Client setup registers its key with the replicas (configuration,
+        # like the network state) — replica-side verification must never
+        # pay the pure-Python key derivation.
+        register_pk(cid, pk)
         for rn in range(RUNG3_REQS):
             payload = b"%d:%d" % (cid, rn)
             sig = ed_host.sign(seed, signing_message(cid, rn, payload))
             presigned[(cid, rn)] = payload + sig + pk
 
-    plane = SignaturePlane(verifier=pallas_verifier)
+    plane = AsyncSignaturePlane()
+    # Warm the plane's launch shape (chunk x sublanes differs from the
+    # microbench's) so the timed run is steady state, not Mosaic compile.
+    from mirbft_tpu.ops.ed25519_pallas import launch_rows, marshal_light
+
+    warm_seed = client_seed(client_ids[0])
+    warm_sig = ed_host.sign(warm_seed, signing_message(client_ids[0], 0, b"w"))
+    warm_row = marshal_light(
+        ed_host.public_key(warm_seed),
+        signing_message(client_ids[0], 0, b"w"),
+        warm_sig,
+    )
+    np.asarray(
+        launch_rows([warm_row] * plane.chunk, sublanes=plane.sublanes)
+    )
+
     start = time.perf_counter()
     rec = BasicRecorder(
         RUNG3_NODES,
@@ -260,10 +292,16 @@ def rung3_run():
     assert all(rec.committed_at(n) == total for n in range(RUNG3_NODES))
     flush_ms = sorted(1e3 * s for s in plane.flush_wall_s)
     p99_ms = flush_ms[min(len(flush_ms) - 1, int(0.99 * len(flush_ms)))]
-    return total / wall, p99_ms, events, sum(plane.flush_sizes)
+    stats = {
+        "rung3_overlapped_launches": plane.overlapped_launches,
+        "rung3_device_verifies": plane.device_verifies,
+        "rung3_host_verifies": plane.host_verifies,
+    }
+    return total / wall, p99_ms, events, sum(plane.flush_sizes), stats
 
 
 def main():
+    _enable_compile_cache()
     from mirbft_tpu.testengine.crypto_plane import AsyncKernelHashPlane
 
     # Ladder first: the microbench's queued device work must not bleed
@@ -280,7 +318,9 @@ def main():
     ed_kernel_rate, ed_host_rate = ed25519_microbench()
     # Rung 3 after the microbench: its verify chunks reuse the freshly
     # compiled Pallas pipeline shapes, so the timed run is all steady state.
-    rung3_rate, rung3_p99, rung3_events, rung3_verified = rung3_run()
+    rung3_rate, rung3_p99, rung3_events, rung3_verified, rung3_stats = (
+        rung3_run()
+    )
 
     total_reqs = CLIENTS * REQS_PER_CLIENT
     committed_rate = total_reqs / tpu_wall
@@ -336,6 +376,7 @@ def main():
                 ),
                 "rung3_engine_events": rung3_events,
                 "rung3_verified_requests": rung3_verified,
+                **rung3_stats,
             }
         )
     )

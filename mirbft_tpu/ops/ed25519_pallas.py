@@ -603,6 +603,51 @@ def marshal_light(pk: bytes, message: bytes, signature: bytes):
     return (pk, signature[:32], s, k)
 
 
+def launch_rows(rows: list, sublanes: int = 16):
+    """Dispatch marshalled rows (from ``marshal_light``) to the device
+    verify pipeline and return the in-flight device array WITHOUT forcing
+    it — the caller polls/forces later (``np.asarray(out)[:len(rows)]``),
+    so device compute and the D2H copy overlap host work.
+
+    Rows pad to a power-of-two bucket (min one tile) by replicating row 0
+    so only O(log(chunk/tile)) shapes ever reach the compiler — the
+    full-ladder Mosaic compile is expensive and must not rerun for every
+    residual tail length.  Padding rows' results are discarded."""
+    from .batching import next_pow2
+
+    tile = sublanes * LANES
+    bucket = next_pow2(len(rows), floor=tile)
+    padded_rows = rows + [rows[0]] * (bucket - len(rows))
+    pk_arr = np.frombuffer(
+        b"".join(r[0] for r in padded_rows), dtype=np.uint8
+    ).reshape(-1, 32)
+    r_arr = np.frombuffer(
+        b"".join(r[1] for r in padded_rows), dtype=np.uint8
+    ).reshape(-1, 32)
+    s_arr = np.frombuffer(
+        b"".join(r[2].to_bytes(32, "little") for r in padded_rows),
+        dtype=np.uint8,
+    ).reshape(-1, 32)
+    k_arr = np.frombuffer(
+        b"".join(r[3].to_bytes(32, "little") for r in padded_rows),
+        dtype=np.uint8,
+    ).reshape(-1, 32)
+    out = _verify_device(
+        _limbs_from_bytes(pk_arr),
+        (pk_arr[:, 31] >> 7).astype(np.int32),
+        _limbs_from_bytes(r_arr),
+        (r_arr[:, 31] >> 7).astype(np.int32),
+        _windows_from_bytes(s_arr),
+        _windows_from_bytes(k_arr),
+        sublanes=sublanes,
+    )
+    try:
+        out.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass  # non-jax arrays (tests) or backends without async D2H
+    return out
+
+
 def verify_batch_pallas(
     pks: list,
     messages: list,
@@ -624,44 +669,11 @@ def verify_batch_pallas(
     rows: list = []
     indices: list = []
 
-    tile = sublanes * LANES
-
     def launch():
         nonlocal rows, indices
         if not rows:
             return
-        # Pad to a power-of-two bucket (min one tile) by replicating row 0
-        # so only O(log(chunk/tile)) shapes ever reach the compiler — the
-        # full-ladder Mosaic compile takes minutes and must not rerun for
-        # every residual tail length.  Padding rows' results are discarded.
-        from .batching import next_pow2
-
-        bucket = next_pow2(len(rows), floor=tile)
-        padded_rows = rows + [rows[0]] * (bucket - len(rows))
-        pk_arr = np.frombuffer(
-            b"".join(r[0] for r in padded_rows), dtype=np.uint8
-        ).reshape(-1, 32)
-        r_arr = np.frombuffer(
-            b"".join(r[1] for r in padded_rows), dtype=np.uint8
-        ).reshape(-1, 32)
-        s_arr = np.frombuffer(
-            b"".join(r[2].to_bytes(32, "little") for r in padded_rows),
-            dtype=np.uint8,
-        ).reshape(-1, 32)
-        k_arr = np.frombuffer(
-            b"".join(r[3].to_bytes(32, "little") for r in padded_rows),
-            dtype=np.uint8,
-        ).reshape(-1, 32)
-        out = _verify_device(
-            _limbs_from_bytes(pk_arr),
-            (pk_arr[:, 31] >> 7).astype(np.int32),
-            _limbs_from_bytes(r_arr),
-            (r_arr[:, 31] >> 7).astype(np.int32),
-            _windows_from_bytes(s_arr),
-            _windows_from_bytes(k_arr),
-            sublanes=sublanes,
-        )
-        pending.append((indices, out))
+        pending.append((indices, launch_rows(rows, sublanes=sublanes)))
         rows, indices = [], []
 
     for i, (pk, msg, sig) in enumerate(zip(pks, messages, signatures)):

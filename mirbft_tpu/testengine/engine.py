@@ -527,6 +527,11 @@ class Recorder:
                 # overlapping device work with the events between here and
                 # the results delivery.
                 self.hash_plane.on_time(when)
+            if self.signature_plane is not None:
+                # Same wave boundary for ingress authentication: requests
+                # submitted at earlier instants may launch their verify
+                # kernels now, ahead of the first delivery's valid() check.
+                self.signature_plane.on_time(when)
         if event is _RESTART:
             self.restart(node)
             return True

@@ -64,6 +64,15 @@ def split_signed(data: bytes):
 _PK_CACHE: dict[int, bytes] = {}
 
 
+def register_pk(client_id: int, pk: bytes) -> None:
+    """Pre-populate the expected-key registry.  A real deployment receives
+    client public keys as configuration (like the network state); deriving
+    them from seeds at first use is harness convenience — client setup
+    (e.g. the pre-signing pass) should register keys so replica-side
+    verification never pays the derivation."""
+    _PK_CACHE[client_id] = pk
+
+
 def _expected_pk(client_id: int, cache: dict = _PK_CACHE) -> bytes:
     pk = cache.get(client_id)
     if pk is None:
@@ -171,6 +180,9 @@ class SignaturePlane:
             self._pending.append((client_id, req_no, data))
             self._verdicts[key] = None  # reserved: pending
 
+    def on_time(self, _now: int) -> None:
+        """Engine hook at simulated-time advancement; the base plane stays
+        fully lazy (AsyncSignaturePlane launches completed waves here)."""
 
     def valid(self, client_id: int, req_no: int, data: bytes) -> bool:
         key = self._key(client_id, req_no, data)
@@ -194,3 +206,169 @@ class SignaturePlane:
         self.flush_wall_s.append(time.perf_counter() - start)
         for item, verdict in zip(batch, verdicts, strict=True):
             self._verdicts[self._key(*item)] = verdict
+
+
+class AsyncSignaturePlane(SignaturePlane):
+    """The accelerator-backed signature plane, tuned the way the digest
+    plane was in round 4 (crypto_plane.AsyncKernelHashPlane):
+
+    - **Cheap rejection at submit time.**  Structural parsing and the
+      client-identity binding (pk == registry pk) run at submit; a request
+      that fails either never reaches a kernel.
+    - **Proactive launching.**  Marshalled rows accumulate into a wave;
+      when simulated time advances past the submission instant (the
+      engine's ``on_time`` hook) — or the wave reaches ``chunk`` rows — the
+      wave dispatches to the device verify pipeline asynchronously.  The
+      ladder kernel then runs while the engine chews through the events
+      between submission and the first delivery (``link_latency`` later),
+      so ``valid()`` usually finds the verdict round trip already done.
+    - **Host verification only for sub-tile stragglers.**  Unlike digests
+      (host hashlib is µs), a host Ed25519 verify is ~5ms of pure Python —
+      so a demanded in-flight chunk *blocks on the device* rather than
+      recomputing, and only waves too small to be worth a padded-tile
+      launch (< ``min_device_rows`` at a wave boundary, or demanded before
+      one) fall back to the host oracle.
+
+    Verdicts depend only on the request bytes, so determinism, event
+    counts, and chains are identical to the synchronous plane.
+    """
+
+    def __init__(
+        self,
+        chunk: int = 1024,
+        sublanes: int = 8,
+        min_device_rows: int = 16,
+        launch_fn=None,
+    ):
+        # Default chunk/sublanes: 1024-row launches on the 8x128 tile.
+        # A monolithic wave would make the FIRST forced readback wait for
+        # the whole kernel; 1024-row pieces queue back-to-back on device,
+        # so the first force blocks ~one piece (<100ms) and later pieces
+        # are ready long before the engine works through the deliveries
+        # standing between it and them.
+        #
+        # min_device_rows=16 ~ the host/device break-even: a host verify
+        # is ~5ms of pure Python per row (always blocking), a padded-tile
+        # launch is ~65ms of device time that overlaps the event loop.
+        #
+        # Deliberately NOT calling super().__init__: the base plane's
+        # verifier/_pending machinery is replaced wholesale by the
+        # wave/chunk state below; only the verdict cache and flush
+        # telemetry are shared contract.
+        self._verdicts = {}
+        self.flush_sizes = []
+        self.flush_wall_s = []
+        self.chunk = chunk
+        self.sublanes = sublanes
+        self.min_device_rows = min_device_rows
+        # launch_fn(rows, sublanes) -> in-flight device verdict array;
+        # pluggable so CPU-only tests can use the XLA scan pipeline (the
+        # Pallas default needs a real TPU).
+        self._launch_fn = launch_fn
+        self._wave: list = []  # [(key, marshal_light row, pk, msg, sig)]
+        self._chunks: dict = {}  # cid -> (keys, out, launch_s)
+        self._chunk_of: dict = {}  # key -> cid
+        self._next_chunk = 0
+        self._dirty = False
+        # Telemetry (bench): launches overlapped with the event loop,
+        # device/host verdict split, demanded-before-ready blocks.
+        self.overlapped_launches = 0
+        self.device_verifies = 0
+        self.host_verifies = 0
+
+    def submit(self, client_id: int, req_no: int, data: bytes) -> None:
+        key = self._key(client_id, req_no, data)
+        if key in self._verdicts:
+            return
+        parts = split_signed(data)
+        if parts is None:
+            self._verdicts[key] = False
+            return
+        payload, sig, pk = parts
+        if pk != _expected_pk(client_id):
+            self._verdicts[key] = False
+            return
+        from ..ops.ed25519_pallas import marshal_light
+
+        msg = signing_message(client_id, req_no, payload)
+        row = marshal_light(pk, msg, sig)
+        if row is None:
+            self._verdicts[key] = False
+            return
+        self._verdicts[key] = None  # pending
+        self._wave.append((key, row, pk, msg, sig))
+        self._dirty = True
+        if len(self._wave) >= self.chunk:
+            self._launch()
+
+    def on_time(self, _now: int) -> None:
+        if self._dirty:
+            self._dirty = False
+            if len(self._wave) >= self.min_device_rows:
+                self._launch()
+
+    def _launch(self) -> None:
+        import time
+
+        if self._launch_fn is None:
+            from ..ops.ed25519_pallas import launch_rows
+
+            self._launch_fn = launch_rows
+        wave, self._wave = self._wave, []
+        start = time.perf_counter()
+        out = self._launch_fn(
+            [row for _k, row, _pk, _m, _s in wave], sublanes=self.sublanes
+        )
+        launch_s = time.perf_counter() - start
+        keys = [k for k, _row, _pk, _m, _s in wave]
+        cid = self._next_chunk
+        self._next_chunk += 1
+        self._chunks[cid] = (keys, out, launch_s)
+        for k in keys:
+            self._chunk_of[k] = cid
+        self.flush_sizes.append(len(keys))
+        self.overlapped_launches += 1
+        self.device_verifies += len(keys)
+
+    def valid(self, client_id: int, req_no: int, data: bytes) -> bool:
+        key = self._key(client_id, req_no, data)
+        if key not in self._verdicts:
+            self.submit(client_id, req_no, data)
+        verdict = self._verdicts[key]
+        if verdict is not None:
+            return verdict
+        cid = self._chunk_of.get(key)
+        if cid is None:
+            self._flush()  # sub-tile wave demanded: host oracle
+            return self._verdicts[key]
+        return self._force(cid, key)
+
+    def _force(self, cid: int, key) -> bool:
+        import time
+
+        import numpy as np
+
+        keys, out, launch_s = self._chunks.pop(cid)
+        start = time.perf_counter()
+        valid = np.asarray(out)
+        self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+        verdicts = self._verdicts
+        chunk_of = self._chunk_of
+        for i, k in enumerate(keys):
+            verdicts[k] = bool(valid[i])
+            del chunk_of[k]
+        return verdicts[key]
+
+    def _flush(self) -> None:
+        """Host-verify the pending (sub-tile) wave synchronously."""
+        if not self._wave:
+            return
+        import time
+
+        wave, self._wave = self._wave, []
+        self.flush_sizes.append(len(wave))
+        start = time.perf_counter()
+        for key, _row, pk, msg, sig in wave:
+            self._verdicts[key] = host.verify(pk, msg, sig)
+        self.flush_wall_s.append(time.perf_counter() - start)
+        self.host_verifies += len(wave)

@@ -213,6 +213,128 @@ def test_signed_run_kernel_verifier_identical():
     assert runs["host"] == runs["kernel"]
 
 
+def _host_launch_rows(rows, sublanes=16):
+    """CPU stand-in for ops.ed25519_pallas.launch_rows with the same
+    contract (marshal_light rows -> forcible verdict array): checks
+    [S]B == R + [k]A with the host point arithmetic.  Lets the async
+    plane's wave/chunk machinery run under the CPU-pinned test conftest
+    (Mosaic has no CPU lowering)."""
+    out = []
+    for pk, r32, s, k in rows:
+        a = host.decompress(pk)
+        r = host.decompress(r32)
+        if a is None or r is None:
+            out.append(False)
+            continue
+        lhs = host.scalar_mult(s, host.to_extended(host.BASE))
+        rhs = host.point_add(r, host.scalar_mult(k, a))
+        out.append(host.point_equal(lhs, rhs))
+    return np.array(out, dtype=bool)
+
+
+def test_async_plane_device_waves_match_sync():
+    """AsyncSignaturePlane (proactive wave launches at time boundaries,
+    verdicts forced at first delivery) produces the identical run to the
+    synchronous demand-flush plane: same event count, same chains; forged
+    requests still die at ingress — now at submit time."""
+    from mirbft_tpu import pb
+    from mirbft_tpu.testengine import BasicRecorder
+    from mirbft_tpu.testengine.signing import (
+        AsyncSignaturePlane,
+        SignaturePlane,
+        host_verifier,
+        make_signer,
+    )
+
+    def run(plane):
+        r = BasicRecorder(
+            node_count=4,
+            client_count=2,
+            reqs_per_client=5,
+            signer=make_signer(),
+            signature_plane=plane,
+        )
+        forged = pb.Request(
+            client_id=4, req_no=99, data=b"evil" + b"\x01" * 96
+        )
+        for node in range(4):
+            r._schedule(
+                0, node, pb.StateEvent(type=pb.EventPropose(request=forged))
+            )
+        count = r.drain_clients(max_steps=200000)
+        for state in r.node_states.values():
+            assert all(rn != 99 for (_c, rn, _s) in state.committed_reqs)
+        return count, tuple(sorted(_chains(r).values()))
+
+    async_plane = AsyncSignaturePlane(
+        min_device_rows=4, launch_fn=_host_launch_rows
+    )
+    sync_run = run(SignaturePlane(verifier=host_verifier))
+    async_run = run(async_plane)
+    assert async_run == sync_run
+    # The async plane actually launched waves ahead of demand.
+    assert async_plane.overlapped_launches >= 1
+    assert async_plane.device_verifies >= 10
+    assert async_plane.host_verifies == 0
+
+
+def test_async_plane_sub_tile_host_fallback():
+    """Waves below min_device_rows never launch; a demanded verdict
+    host-verifies the pending wave synchronously (the straggler path)."""
+    from mirbft_tpu.testengine import BasicRecorder
+    from mirbft_tpu.testengine.signing import AsyncSignaturePlane, make_signer
+
+    def no_launch(rows, sublanes=16):
+        raise AssertionError("sub-tile wave must not reach the device")
+
+    plane = AsyncSignaturePlane(min_device_rows=10**6, launch_fn=no_launch)
+    r = BasicRecorder(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=3,
+        signer=make_signer(),
+        signature_plane=plane,
+    )
+    r.drain_clients(max_steps=200000)
+    assert len(set(_chains(r).values())) == 1
+    assert plane.host_verifies >= 6
+    assert plane.overlapped_launches == 0
+
+
+def test_async_plane_rejects_before_launch():
+    """Structural garbage and client-identity mismatches are rejected at
+    submit time without consuming kernel work."""
+    from mirbft_tpu.testengine.signing import (
+        AsyncSignaturePlane,
+        client_seed,
+        make_signer,
+        signing_message,
+    )
+
+    def no_launch(rows, sublanes=16):
+        raise AssertionError("rejected rows must not reach a wave")
+
+    plane = AsyncSignaturePlane(launch_fn=no_launch)
+    # Too short for the sig+pk trailer.
+    plane.submit(7, 0, b"tiny")
+    assert plane.valid(7, 0, b"tiny") is False
+    # Right shape, wrong public key for the claimed client id.
+    wrong_pk = host.public_key(b"\x09" * 32)
+    sig = host.sign(b"\x09" * 32, signing_message(7, 1, b"payload"))
+    assert plane.valid(7, 1, b"payload" + sig + wrong_pk) is False
+    # Correct key but corrupted signature: this one DOES need crypto —
+    # and a sub-tile host flush resolves it (no launch).
+    plane2 = AsyncSignaturePlane(
+        min_device_rows=10**6, launch_fn=no_launch
+    )
+    signer = make_signer()
+    good = signer(7, 2, b"payload")
+    corrupted = bytes([good[0] ^ 1]) + good[1:]
+    assert plane2.valid(7, 2, corrupted) is False
+    assert plane2.valid(7, 2, good) is True
+    assert plane2.host_verifies == 2
+
+
 # -- Pallas kernels (ops/ed25519_pallas.py) ---------------------------------
 
 
