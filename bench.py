@@ -141,6 +141,11 @@ def ladder_run(hash_plane=None):
         # consumed the instant they are submitted.
         params=RuntimeParameters(ready_latency=READY_LATENCY_MS),
         hash_plane=hash_plane,
+        # Steady-state timing: the in-memory recorded-events list is not
+        # consensus work and dominates the wall now that the event count
+        # is small (an interceptor-based recorder would be the production
+        # path at this scale).
+        record=False,
     )
     events = rec.drain_clients(max_steps=20_000_000)
     wall = time.perf_counter() - start
@@ -308,6 +313,11 @@ def main():
     # into the timed consensus run.
     plane = AsyncKernelHashPlane()
     warm_kernel_shapes(plane)
+    # Offload break-even calibration: through the tunneled dev device the
+    # round trip is tens of ms and digests stay host-side (the plane is
+    # opportunistic — it never stalls the loop on the device); on directly
+    # attached hardware the threshold drops and waves offload.
+    rtt_s = plane.calibrate()
     tpu_wall, events, chain = ladder_run(hash_plane=plane)
     host_wall, host_events, host_chain = ladder_run()
     assert events == host_events, "kernel run diverged from host run!"
@@ -325,7 +335,13 @@ def main():
     total_reqs = CLIENTS * REQS_PER_CLIENT
     committed_rate = total_reqs / tpu_wall
     flush_ms = sorted(1e3 * s for s in plane.flush_wall_s)
-    p99_ms = flush_ms[min(len(flush_ms) - 1, int(0.99 * len(flush_ms)))]
+    # Inline-bypass mode (device below break-even) has no deferred
+    # flushes; the blocking digest latency is then one hashlib call.
+    p99_ms = (
+        flush_ms[min(len(flush_ms) - 1, int(0.99 * len(flush_ms)))]
+        if flush_ms
+        else 0.0
+    )
 
     print(
         json.dumps(
@@ -352,6 +368,8 @@ def main():
                 "crypto_plane_device_digests": plane.device_digests,
                 "crypto_plane_host_digests": plane.host_digests,
                 "crypto_plane_rescued_digests": plane.rescued_digests,
+                "crypto_plane_device_rtt_ms": round(1e3 * rtt_s, 2),
+                "crypto_plane_min_device_rows": plane.min_device_rows,
                 "engine_events": events,
                 "kernel_compressions_per_sec": round(
                     max(xla_rate, pallas_rate), 1

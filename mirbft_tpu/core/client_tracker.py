@@ -272,18 +272,23 @@ class ClientReqNo:
             self.requests[key] = req
         return req
 
-    def apply_request_digest(self, ack: pb.RequestAck, data: bytes) -> Actions:
+    def apply_request_digest(
+        self, ack: pb.RequestAck, data: bytes, out: Actions | None = None
+    ) -> Actions:
         """Our own verified copy of the request (via Propose hash or a
-        verified forward): persist it and ACK it to the network."""
+        verified forward): persist it and ACK it to the network.  ``out``
+        lets the hot result-processing loop accumulate into one Actions
+        instead of allocating + concatenating one per request."""
+        actions = out if out is not None else Actions()
         if ack.digest in self.my_requests:
             # Race between a forward and a local proposal; already persisted.
-            return Actions()
+            return actions
 
         req = self.client_req(ack)
         req.stored = True
         self.my_requests[ack.digest] = req
 
-        actions = Actions().store_request(
+        actions.store_request(
             pb.ForwardRequest(request_ack=ack, request_data=data)
         )
 
@@ -431,6 +436,380 @@ class ClientReqNo:
             actions = Actions()
         actions.send(self.network_config.nodes, pb.Msg(type=ack))
         return actions
+
+
+# ---------------------------------------------------------------------------
+# Columnar ack fast path
+# ---------------------------------------------------------------------------
+
+# One-deep cache of the last frame's column decomposition, keyed by the
+# msgs list object.  The engine delivers one coalesced frame to many
+# receivers back to back; holding a strong reference to the list keeps the
+# identity check sound.
+_FRAME_COLS: list = [None, None]
+
+
+def _frame_columns(msgs: list):
+    """msgs -> (client_ids int64[n], req_nos int64[n], digest matrix
+    uint8[n, 32], irregular row indices or None).  Rows whose digest is
+    not 32 bytes (null acks) zero-fill the matrix and appear in
+    ``irregular`` so the vector path routes them to the fallback."""
+    cached = _FRAME_COLS
+    if cached[0] is msgs:
+        return cached[1]
+    import numpy as np
+
+    n = len(msgs)
+    ids = np.empty(n, dtype=np.int64)
+    rnos = np.empty(n, dtype=np.int64)
+    digs = [None] * n
+    irregular = None
+    for i, msg in enumerate(msgs):
+        ack = msg.type
+        ids[i] = ack.client_id
+        rnos[i] = ack.req_no
+        d = ack.digest
+        if len(d) != 32:
+            d = b"\x00" * 32
+            if irregular is None:
+                irregular = []
+            irregular.append(i)
+        digs[i] = d
+    dig_mat = np.frombuffer(b"".join(digs), dtype=np.uint8).reshape(n, 32)
+    cols = (ids, rnos, dig_mat, irregular)
+    cached[0] = msgs
+    cached[1] = cols
+    return cols
+
+
+class _FastAcks:
+    """Vectorized mirror of every client window's ack-certificate state.
+
+    The ack fan-in is the hottest loop in the framework: every request
+    draws one RequestAck from every node at every node — O(n^2)
+    applications per request, arriving in coalesced frames of thousands.
+    This mirror lets ``step_ack_many`` apply a whole frame as a handful
+    of numpy ops (bitwise OR + popcount over uint64 masks, digest
+    equality over a (slots, 32) byte matrix) instead of ~12 dict/attr
+    operations per ack.
+
+    Authority contract: the arrays are authoritative only INSIDE one
+    ``step_ack_many`` call — every row it changes is written back to the
+    owning ``ClientRequest``/``ClientReqNo`` objects before returning, so
+    all other code keeps reading and mutating objects exactly as before.
+    Paths that mutate ack state elsewhere refresh the touched slot
+    (``refresh``) or drop the whole mirror (``ClientTracker._fast =
+    None``; it lazily rebuilds).  Window-structure changes (checkpoint
+    allocation, GC, reinitialize) drop it.
+
+    Only configs whose node ids fit a uint64 mask (< 64) build a mirror;
+    larger networks keep the plain loop.
+
+    Per-slot flags: COMMITTED (drop acks early), SLOW (anything the
+    vector path cannot express: missing slot, conflicting digests, a
+    null request, or no canonical digest yet — those rows take the
+    original per-ack path and the slot refreshes afterwards).
+    """
+
+    COMMITTED = 1
+    SLOW = 2
+
+    __slots__ = (
+        "cid0",
+        "n_clients",
+        "offset_arr",
+        "base_arr",
+        "low_arr",
+        "high_arr",
+        "nrm_arr",
+        "clients",
+        "client_of",
+        "agree",
+        "nonnull",
+        "flags",
+        "canon_mat",
+        "canon_ok",
+        "canon_req",
+        "canon_crn",
+        "tick_dirty",
+        "tick_class",
+        "tsa",
+        "tgt",
+        "canon_mat_dirty",
+        "weak_q",
+        "strong_q",
+    )
+
+    def __init__(self, tracker: "ClientTracker"):
+        import numpy as np
+
+        clients = tracker.clients
+        cids = sorted(clients)
+        self.cid0 = cids[0]
+        self.n_clients = cids[-1] - cids[0] + 1
+        # Dense index over [cid0, cid_last]; ids outside or in gaps resolve
+        # to a sentinel client slot with an empty window (rows fall back).
+        self.offset_arr = np.zeros(self.n_clients + 1, dtype=np.int64)
+        self.base_arr = np.zeros(self.n_clients + 1, dtype=np.int64)
+        self.low_arr = np.zeros(self.n_clients + 1, dtype=np.int64)
+        self.high_arr = np.full(self.n_clients + 1, -1, dtype=np.int64)
+        self.nrm_arr = np.full(self.n_clients + 1, -1, dtype=np.int64)
+        self.clients: list = [None] * (self.n_clients + 1)
+
+        total = 0
+        metas = []
+        for cid in cids:
+            client = clients[cid]
+            ci = cid - self.cid0
+            size = client.high_watermark - client.low_watermark + 1
+            self.offset_arr[ci] = total
+            self.base_arr[ci] = client.low_watermark
+            self.low_arr[ci] = client.low_watermark
+            self.high_arr[ci] = client.high_watermark
+            self.nrm_arr[ci] = client.next_ready_mark
+            self.clients[ci] = client
+            metas.append((client, ci, total, size))
+            total += size
+
+        self.agree = np.zeros(total, dtype=np.uint64)
+        self.nonnull = np.zeros(total, dtype=np.uint64)
+        self.flags = np.zeros(total, dtype=np.uint8)
+        self.canon_mat = np.zeros((total, 32), dtype=np.uint8)
+        self.canon_ok = np.zeros(total, dtype=bool)
+        self.canon_req: list = [None] * total
+        self.canon_crn: list = [None] * total
+        self.client_of = np.zeros(total, dtype=np.int64)
+        # Slots whose ack activity has not yet been pushed into the owning
+        # client's _tick_pending set (drained lazily at tick time — the
+        # per-ack set.add was a measurable fraction of the old loop).
+        self.tick_dirty = np.zeros(total, dtype=bool)
+        # Vectorized tick state: INERT slots cannot fire, STEADY slots only
+        # advance the rebroadcast backoff counter (held authoritatively in
+        # ``tsa`` between syncs; ``crn.tick`` is its only reader and gets a
+        # sync immediately before any call), PYTHON slots (fetch machinery
+        # in motion, pending null promotion) take the per-slot path.
+        self.tick_class = np.zeros(total, dtype=np.uint8)
+        self.tsa = np.zeros(total, dtype=np.int64)
+        self.tgt = np.zeros(total, dtype=np.int64)
+        # Deferred canonical-digest rows: writing one 32-byte canon_mat row
+        # per refresh costs ~1.2us in frombuffer+scatter; batching them into
+        # one fancy-indexed write at the next vector read halves the
+        # per-refresh cost on the hot store-request path.
+        self.canon_mat_dirty: list = []
+
+        nc = tracker.network_config
+        self.weak_q = some_correct_quorum(nc)
+        self.strong_q = intersection_quorum(nc)
+
+        # Bulk build: gather per-slot values into Python lists and assign
+        # each column once (per-element numpy scalar writes made the
+        # per-slot _refresh_slot ~6x slower at build scale).
+        agree_l = [0] * total
+        nonnull_l = [0] * total
+        flags_l = [0] * total
+        dig_l = [b"\x00" * 32] * total
+        ok_l = [False] * total
+        tick_l = [0] * total
+        tsa_l = [0] * total
+        tgt_l = [0] * total
+        canon_req = self.canon_req
+        canon_crn = self.canon_crn
+        for client, ci, offset, size in metas:
+            base = client.low_watermark
+            req_no_map = client.req_no_map
+            self.client_of[offset : offset + size] = ci
+            for i in range(size):
+                slot = offset + i
+                crn = req_no_map.get(base + i)
+                if crn is None:
+                    flags_l[slot] = self.SLOW
+                    continue
+                canon_crn[slot] = crn
+                if crn.committed is not None:
+                    flags_l[slot] = self.COMMITTED
+                    continue
+                requests = crn.requests
+                if len(requests) == 1 and _NULL not in requests:
+                    (digest,) = requests
+                    req = requests[digest]
+                    dig_l[slot] = digest
+                    ok_l[slot] = True
+                    canon_req[slot] = req
+                    agree_l[slot] = req.agreements
+                    nonnull_l[slot] = crn.non_null_voters
+                else:
+                    flags_l[slot] = self.SLOW
+                tick_cls = self._classify_tick(crn)
+                tick_l[slot] = tick_cls
+                if tick_cls == self.TICK_STEADY:
+                    tsa_l[slot] = crn.ticks_since_ack
+                    tgt_l[slot] = crn.acks_sent * _ACK_RESEND_TICKS
+        self.agree[:] = agree_l
+        self.nonnull[:] = nonnull_l
+        self.flags[:] = flags_l
+        self.canon_ok[:] = ok_l
+        self.tick_class[:] = tick_l
+        self.tsa[:] = tsa_l
+        self.tgt[:] = tgt_l
+        self.canon_mat[:] = np.frombuffer(
+            b"".join(dig_l), dtype=np.uint8
+        ).reshape(total, 32)
+
+    def drain_tick_dirty(self) -> None:
+        """Push deferred ack activity into the clients' _tick_pending sets
+        (must run before any tick iteration and before the mirror drops)."""
+        import numpy as np
+
+        idx = np.flatnonzero(self.tick_dirty)
+        if not len(idx):
+            return
+        self.tick_dirty[idx] = False
+        clients = self.clients
+        offset_arr = self.offset_arr
+        base_arr = self.base_arr
+        client_of = self.client_of
+        for slot in idx.tolist():
+            ci = client_of[slot]
+            clients[ci]._tick_pending.add(
+                int(base_arr[ci]) + slot - int(offset_arr[ci])
+            )
+
+    def slot_of(self, client_id: int, req_no: int) -> int | None:
+        ci = client_id - self.cid0
+        if not (0 <= ci < self.n_clients):
+            return None
+        if not (self.low_arr[ci] <= req_no <= self.high_arr[ci]):
+            return None
+        return int(self.offset_arr[ci]) + req_no - int(self.base_arr[ci])
+
+    # Tick classes (see the tick_class array comment above).
+    TICK_INERT = 0
+    TICK_STEADY = 1
+    TICK_PYTHON = 2
+
+    def refresh(
+        self, client_id: int, req_no: int, tick_obj_authoritative: bool = False
+    ) -> None:
+        """Re-derive one slot's mirror from the authoritative objects.
+
+        ``tick_obj_authoritative``: the caller just mutated the crn's tick
+        counters (ticks_since_ack/acks_sent), so skip the array→object
+        writeback that normally preserves a STEADY slot's advanced backoff
+        counter."""
+        slot = self.slot_of(client_id, req_no)
+        if slot is None:
+            return
+        ci = client_id - self.cid0
+        client = self.clients[ci]
+        self._refresh_slot(
+            slot,
+            client.req_no_map.get(req_no),
+            tick_obj_authoritative=tick_obj_authoritative,
+        )
+
+    def _refresh_slot(
+        self,
+        slot: int,
+        crn: "ClientReqNo | None",
+        tick_obj_authoritative: bool = False,
+    ) -> None:
+        # For STEADY slots the backoff counter lives in the array between
+        # syncs; push it back before re-deriving from the object (unless
+        # the caller just wrote a newer value there).
+        if (
+            not tick_obj_authoritative
+            and self.tick_class[slot] == self.TICK_STEADY
+        ):
+            old_crn = self.canon_crn[slot]
+            if old_crn is not None:
+                old_crn.ticks_since_ack = int(self.tsa[slot])
+
+        if crn is None:
+            self.flags[slot] = self.SLOW
+            self.canon_crn[slot] = None
+            self.canon_req[slot] = None
+            self.canon_ok[slot] = False
+            self.tick_class[slot] = self.TICK_INERT
+            return
+        self.canon_crn[slot] = crn
+        if crn.committed is not None:
+            self.flags[slot] = self.COMMITTED
+            self.tick_class[slot] = self.TICK_INERT
+            return
+        requests = crn.requests
+        if len(requests) == 1 and _NULL not in requests:
+            (digest,) = requests
+            req = requests[digest]
+            self.canon_mat_dirty.append((slot, digest))
+            self.canon_ok[slot] = True
+            self.canon_req[slot] = req
+            self.agree[slot] = req.agreements
+            self.nonnull[slot] = crn.non_null_voters
+            self.flags[slot] = 0
+        else:
+            # No votes yet (first ack adopts its digest via the per-row
+            # fallback, which then refreshes this slot), or conflicting
+            # digests / a null request in play.
+            self.canon_ok[slot] = False
+            self.canon_req[slot] = None
+            self.flags[slot] = self.SLOW
+        self.tick_class[slot] = self._classify_tick(crn)
+        if self.tick_class[slot] == self.TICK_STEADY:
+            self.tsa[slot] = crn.ticks_since_ack
+            self.tgt[slot] = crn.acks_sent * _ACK_RESEND_TICKS
+
+    def flush_canon_rows(self) -> None:
+        """Apply deferred canonical-digest rows (one batched write)."""
+        dirty = self.canon_mat_dirty
+        if not dirty:
+            return
+        import numpy as np
+
+        self.canon_mat_dirty = []
+        slots = np.fromiter(
+            (s for s, _d in dirty), dtype=np.int64, count=len(dirty)
+        )
+        rows = np.frombuffer(
+            b"".join(d for _s, d in dirty), dtype=np.uint8
+        ).reshape(len(dirty), 32)
+        # Later entries for the same slot win (list order == apply order).
+        self.canon_mat[slots] = rows
+
+    def _classify_tick(self, crn: "ClientReqNo") -> int:
+        """Mirror of ClientReqNo.tick's control flow (that method stays the
+        semantic reference): which slots can the vectorized tick skip or
+        batch-advance?"""
+        my = crn.my_requests
+        weak = crn.weak_requests
+        if not my and not weak:
+            return self.TICK_INERT
+        if len(weak) > 1 and _NULL not in my:
+            return self.TICK_PYTHON  # null promotion pending
+        for cr in weak.values():
+            if (not cr.stored) or cr.fetching:
+                return self.TICK_PYTHON  # fetch machinery in motion
+        if crn.acks_sent == 0:
+            return self.TICK_INERT  # nothing held: rebroadcast gate closed
+        return self.TICK_STEADY
+
+    def writeback_tick(self) -> None:
+        """Sync every STEADY slot's array-held backoff counter back to its
+        crn (before the mirror drops or the python tick path runs)."""
+        import numpy as np
+
+        idx = np.flatnonzero(self.tick_class == self.TICK_STEADY)
+        canon_crn = self.canon_crn
+        tsa = self.tsa
+        for s in idx.tolist():
+            crn = canon_crn[s]
+            if crn is not None:
+                crn.ticks_since_ack = int(tsa[s])
+
+    def mark_committed(self, client_id: int, req_no: int) -> None:
+        slot = self.slot_of(client_id, req_no)
+        if slot is not None:
+            self.flags[slot] = self.COMMITTED
+            self.tick_class[slot] = self.TICK_INERT
 
 
 # ---------------------------------------------------------------------------
@@ -681,10 +1060,24 @@ class ClientTracker:
         self.msg_buffers: dict[int, MsgBuffer] = {}
         self.ready_list = ReadyList()
         self.available_list = AvailableList()
+        # Columnar ack mirror (see _FastAcks), built lazily by
+        # step_ack_many when the config supports it.
+        self._fast: _FastAcks | None = None
+        self._fast_ok = False
+
+    def _drop_fast(self) -> None:
+        """Invalidate the columnar mirror (draining deferred tick activity
+        and syncing array-held backoff counters first so no rebroadcast/
+        fetch bookkeeping is lost)."""
+        if self._fast is not None:
+            self._fast.drain_tick_dirty()
+            self._fast.writeback_tick()
+            self._fast = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def reinitialize(self) -> None:
+        self._drop_fast()
         low_c = high_c = None
 
         def on_c(c_entry):
@@ -745,11 +1138,64 @@ class ClientTracker:
                 )
             self.msg_buffers[node_id] = buffer
 
+        # The vector ack path needs every node id in a uint64 mask and a
+        # dense-ish client id range (the mirror indexes [cid0, cid_last]).
+        nodes = self.network_config.nodes
+        cids = [cs.id for cs in self.client_states]
+        self._fast_ok = bool(
+            nodes
+            and max(nodes) < 64
+            and cids
+            and (max(cids) - min(cids) + 1) <= 4 * len(cids) + 1024
+        )
+
     def tick(self) -> Actions:
+        fast = self._fast
+        if fast is not None:
+            fast.drain_tick_dirty()
+            return self._tick_vector(fast)
         actions = Actions()
         for client_state in self.client_states:
             actions.concat(self.clients[client_state.id].tick())
         return actions
+
+    def _tick_vector(self, fast: "_FastAcks") -> Actions:
+        """Vectorized tick sweep over the mirror: INERT slots skip, quiet
+        STEADY slots advance their backoff counter in one array op, and
+        only firing/PYTHON slots run ClientReqNo.tick (the semantic
+        reference for all of this)."""
+        import numpy as np
+
+        steady = fast.tick_class == _FastAcks.TICK_STEADY
+        fire = steady & (fast.tsa == fast.tgt)
+        quiet = steady & ~fire
+        fast.tsa[quiet] += 1
+
+        todo = np.flatnonzero(
+            fire | (fast.tick_class == _FastAcks.TICK_PYTHON)
+        )
+        if not len(todo):
+            return _EMPTY_ACTIONS
+        actions = None
+        canon_crn = fast.canon_crn
+        for s in todo.tolist():
+            crn = canon_crn[s]
+            if crn is None:
+                continue
+            if fire[s]:
+                # The array held the authoritative backoff counter; sync it
+                # so crn.tick sees the fire condition.
+                crn.ticks_since_ack = int(fast.tsa[s])
+            crn_actions = crn.tick()
+            # crn.tick mutated its own counters (fire reset, fetch
+            # progress, possibly a null promotion): re-derive the slot.
+            fast._refresh_slot(s, crn, tick_obj_authoritative=True)
+            if crn_actions is not _EMPTY_ACTIONS:
+                if actions is None:
+                    actions = crn_actions
+                else:
+                    actions.concat(crn_actions)
+        return actions if actions is not None else _EMPTY_ACTIONS
 
     # -- message handling ----------------------------------------------------
 
@@ -811,12 +1257,149 @@ class ClientTracker:
             self.available_list.push_back(crn.requests.get(key))
         if req_no == client.next_ready_mark and crn.strong_requests:
             self.check_ready(client, crn)
+        if self._fast is not None:
+            self._fast.refresh(ack.client_id, req_no)
         return _EMPTY_ACTIONS
 
     def step_ack_many(self, source: int, msgs: list) -> None:
         """Bulk form of step_ack for one inbound frame: identical semantics,
         per-frame rather than per-msg frame setup.  ``msgs`` must all carry
-        RequestAck payloads."""
+        RequestAck payloads.
+
+        Large frames on vector-capable configs take the columnar path:
+        the whole frame becomes numpy column arrays (cached on the frame,
+        so the other receivers of the same coalesced delivery reuse them)
+        and applies as bitwise OR + popcount over the _FastAcks mirror.
+        Rows the mirror cannot express — unknown clients, out-of-window,
+        null acks, conflicting digests, first vote for a slot — fall back
+        to step_ack per row (note the fallback rows apply AFTER the
+        vectorized rows rather than in strict frame-interleaved order;
+        both orders are deterministic, and inter-row order within one
+        frame was never a protocol guarantee)."""
+        if len(msgs) >= 32 and self._fast_ok:
+            fast = self._fast
+            if fast is None:
+                fast = self._fast = _FastAcks(self)
+            self._step_ack_vector(source, msgs, fast)
+            return
+        self._step_ack_loop(source, msgs)
+
+    def _step_ack_vector(
+        self, source: int, msgs: list, fast: "_FastAcks"
+    ) -> None:
+        import numpy as np
+
+        fast.flush_canon_rows()
+        ids, rnos, dig_mat, irregular = _frame_columns(msgs)
+        n = len(msgs)
+
+        ci = ids - fast.cid0
+        known = (ci >= 0) & (ci < fast.n_clients)
+        cis = np.where(known, ci, fast.n_clients)  # sentinel: empty window
+        in_win = (rnos >= fast.low_arr[cis]) & (rnos <= fast.high_arr[cis])
+        slot = np.where(
+            in_win, fast.offset_arr[cis] + rnos - fast.base_arr[cis], 0
+        )
+        fl = fast.flags[slot]
+        live = in_win & (fl == 0)
+        canon_match = fast.canon_ok[slot] & (
+            fast.canon_mat[slot] == dig_mat
+        ).all(axis=1)
+        vec = live & canon_match
+        if irregular is not None:
+            vec[irregular] = False
+
+        # Late acks for committed slots drop outright (same early-out as
+        # the loop); everything else the mirror cannot express — buffering,
+        # conflicts, canonical adoption — takes the original per-ack path
+        # after the vectorized rows, with a slot refresh each.
+        fb_rows = np.flatnonzero(
+            ~vec & ~(in_win & (fl == _FastAcks.COMMITTED))
+        )
+
+        vrows = np.flatnonzero(vec)
+        if len(vrows):
+            bit = np.uint64(1 << source)
+            vslot = slot[vrows]
+            old = fast.agree[vslot]
+            nn = fast.nonnull[vslot]
+            dup = (old & bit) != np.uint64(0)
+            # A voter whose non-null vote went to a different digest gets
+            # no second vote (the spam guard).
+            foreign = ((nn & bit) != np.uint64(0)) & ~dup
+            apply_m = ~foreign
+            new = old | bit
+            nn_new = nn | bit
+            ap = np.flatnonzero(apply_m)
+            ap_slots = vslot[ap]
+            # Duplicate slots within one frame all OR the same source bit,
+            # so last-write-wins scatter is exact.
+            fast.agree[ap_slots] = new[ap]
+            fast.nonnull[ap_slots] = nn_new[ap]
+            fast.tick_dirty[ap_slots] = True
+
+            counts = np.bitwise_count(new)
+            changed = apply_m & ~dup
+            # Object writeback: the mirror is authoritative only inside
+            # this call.
+            canon_req = fast.canon_req
+            canon_crn = fast.canon_crn
+            ch = np.flatnonzero(changed)
+            ch_slots = vslot[ch].tolist()
+            ch_agree = new[ch].tolist()
+            ch_nn = nn_new[ch].tolist()
+            for s, a, v in zip(ch_slots, ch_agree, ch_nn):
+                canon_req[s].agreements = a
+                canon_crn[s].non_null_voters = v
+
+            # Quorum crossings (one bit per frame per slot: equality is
+            # exact).  Rare relative to acks — plain Python per crossing.
+            weak_cross = np.flatnonzero(changed & (counts == fast.weak_q))
+            if len(weak_cross):
+                available_push = self.available_list.push_back
+                for j in weak_cross.tolist():
+                    s = int(vslot[j])
+                    req = canon_req[s]
+                    crn = canon_crn[s]
+                    # A duplicate ack in the same frame reads the same
+                    # pre-scatter state and lands here twice; the dict
+                    # membership check keeps the available push single
+                    # (the loop path's was_weak guard).
+                    if req.ack.digest in crn.weak_requests:
+                        continue
+                    crn.weak_requests[req.ack.digest] = req
+                    available_push(req)
+                    # Weak membership feeds the tick classification (an
+                    # unstored newly-weak request needs fetch ticks).
+                    fast._refresh_slot(s, crn)
+            strong_cross = np.flatnonzero(changed & (counts == fast.strong_q))
+            if len(strong_cross):
+                for j in strong_cross.tolist():
+                    s = int(vslot[j])
+                    req = canon_req[s]
+                    crn = canon_crn[s]
+                    crn.strong_requests[req.ack.digest] = req
+
+            # Ready-mark checks: applied rows sitting exactly at their
+            # client's next_ready_mark (advance_ready self-advances, so one
+            # call per hit is enough; nrm_arr is synced by advance_ready).
+            cand = np.flatnonzero(
+                apply_m & (rnos[vrows] == fast.nrm_arr[cis[vrows]])
+            )
+            for j in cand.tolist():
+                s = int(vslot[j])
+                crn = canon_crn[s]
+                if crn.strong_requests:
+                    self.check_ready(fast.clients[int(cis[vrows[j]])], crn)
+
+        if len(fb_rows):
+            step_ack = self.step_ack
+            for r in fb_rows.tolist():
+                step_ack(source, msgs[r])  # refreshes the mirror itself
+
+    def _step_ack_loop(self, source: int, msgs: list) -> None:
+        """The reference per-ack path (also the semantic spec for the
+        vectorized form above)."""
         clients_get = self.clients.get
         available_push = self.available_list.push_back
         bit = 1 << source
@@ -904,14 +1487,33 @@ class ClientTracker:
 
     # -- request arrival paths ----------------------------------------------
 
-    def apply_request_digest(self, ack: pb.RequestAck, data: bytes) -> Actions:
+    def apply_request_digest(
+        self, ack: pb.RequestAck, data: bytes, out: Actions | None = None
+    ) -> Actions:
         client = self.clients.get(ack.client_id)
         if client is None:
-            return Actions()  # client removed since the request was hashed
+            # Client removed since the request was hashed.
+            return out if out is not None else Actions()
         if not client.in_watermarks(ack.req_no):
-            return Actions()  # already committed / out of window
+            # Already committed / out of window.
+            return out if out is not None else Actions()
         client._tick_pending.add(ack.req_no)
-        return client.req_no(ack.req_no).apply_request_digest(ack, data)
+        crn = client.req_no(ack.req_no)
+        had_my = len(crn.my_requests)
+        actions = crn.apply_request_digest(ack, data, out)
+        if self._fast is not None:
+            # May have created the slot's first (or a conflicting) request
+            # entry and reset the rebroadcast counters: re-derive the
+            # mirror's canonical + tick view.  The tick counters were only
+            # touched if something was actually stored (the already-
+            # persisted early return leaves them alone, and the mirror's
+            # advanced copy must then survive the refresh).
+            self._fast.refresh(
+                ack.client_id,
+                ack.req_no,
+                tick_obj_authoritative=len(crn.my_requests) != had_my,
+            )
+        return actions
 
     def reply_fetch_request(
         self, source: int, client_id: int, req_no: int, digest: bytes
@@ -943,6 +1545,10 @@ class ClientTracker:
         if req.agreements & (1 << self.my_config.id):
             return Actions()  # we already hold + acked it
         req.agreements |= 1 << source
+        if self._fast is not None:
+            self._fast.refresh(
+                msg.request_ack.client_id, msg.request_ack.req_no
+            )
         return Actions().hash(
             request_hash_data(
                 pb.Request(
@@ -971,6 +1577,8 @@ class ClientTracker:
         if newly_correct:
             self.available_list.push_back(cr)
         self.check_ready(client, crn)
+        if self._fast is not None:
+            self._fast.refresh(ack.client_id, ack.req_no)
         return cr
 
     def check_ready(self, client: Client, crn: ClientReqNo) -> None:
@@ -996,6 +1604,10 @@ class ClientTracker:
                 if digest in crn.my_requests:
                     self.ready_list.push_back(crn)
                     client.next_ready_mark = req_no + 1
+                    if self._fast is not None:
+                        ci = client.client_state.id - self._fast.cid0
+                        if 0 <= ci < self._fast.n_clients:
+                            self._fast.nrm_arr[ci] = req_no + 1
                     break
 
     # -- checkpoint interplay ------------------------------------------------
@@ -1064,6 +1676,7 @@ class ClientTracker:
             client.allocate(seq_no, state)
 
         self.client_states = new_states
+        self._drop_fast()  # windows advanced: mirror shape is stale
         return new_states
 
     def drain(self) -> Actions:
@@ -1076,11 +1689,22 @@ class ClientTracker:
             )
         return actions
 
+    def fetch_request(self, cr: ClientRequest) -> Actions:
+        """Fetch a known-correct request (epoch-change path); mediated
+        here so the fetching-state flip reclassifies the mirror slot."""
+        actions = cr.fetch()
+        if self._fast is not None:
+            self._fast.refresh(cr.ack.client_id, cr.ack.req_no)
+        return actions
+
     def mark_committed(self, client_id: int, req_no: int, seq_no: int) -> None:
         """Called by commit state as batches are applied."""
         self.clients[client_id].req_no(req_no).committed = seq_no
+        if self._fast is not None:
+            self._fast.mark_committed(client_id, req_no)
 
     def garbage_collect(self, seq_no: int) -> None:
+        self._drop_fast()  # windows slide: mirror slots remap
         for client_state in self.client_states:
             self.clients[client_state.id].move_low_watermark(seq_no)
         self.available_list.garbage_collect(seq_no)

@@ -330,7 +330,7 @@ class EpochTarget:
                 if cr is None or cr.agreements & (1 << self.my_config.id):
                     continue
                 fetch_pending = True
-                actions.concat(cr.fetch())
+                actions.concat(self.client_tracker.fetch_request(cr))
 
         if fetch_pending:
             return actions

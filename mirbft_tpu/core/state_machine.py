@@ -151,6 +151,29 @@ class StateMachine:
                 )
             return self._propose(inner.request)
 
+        if inner_type is pb.EventProposeBatch:
+            # Same fast path, batched: one delivery carrying many local
+            # proposals emits one hash action per request and nothing else
+            # (exactly as if each arrived as its own EventPropose in list
+            # order).
+            if self._state is not _SMState.INITIALIZED:
+                raise AssertionError(
+                    "cannot apply EventProposeBatch before initialization"
+                )
+            batch_actions = Actions()
+            my_id = self.my_config.id
+            for request in inner.requests:
+                batch_actions.hash(
+                    request_hash_data(request),
+                    pb.HashResult(
+                        digest=b"",
+                        type=pb.HashOriginRequest(
+                            source=my_id, request=request
+                        ),
+                    ),
+                )
+            return batch_actions
+
         actions = Actions()
 
         if inner_type is pb.EventInitialize:
@@ -336,15 +359,14 @@ class StateMachine:
                 )
             elif isinstance(origin, pb.HashOriginRequest):
                 req = origin.request
-                actions.concat(
-                    self.client_tracker.apply_request_digest(
-                        pb.RequestAck(
-                            client_id=req.client_id,
-                            req_no=req.req_no,
-                            digest=digest,
-                        ),
-                        req.data,
-                    )
+                self.client_tracker.apply_request_digest(
+                    pb.RequestAck(
+                        client_id=req.client_id,
+                        req_no=req.req_no,
+                        digest=digest,
+                    ),
+                    req.data,
+                    out=actions,
                 )
             elif isinstance(origin, pb.HashOriginVerifyRequest):
                 if origin.request_ack.digest != digest:
@@ -362,10 +384,8 @@ class StateMachine:
                             req_no=origin.request_ack.req_no,
                         )
                 else:
-                    actions.concat(
-                        self.client_tracker.apply_request_digest(
-                            origin.request_ack, origin.request_data
-                        )
+                    self.client_tracker.apply_request_digest(
+                        origin.request_ack, origin.request_data, out=actions
                     )
             elif isinstance(origin, pb.HashOriginEpochChange):
                 actions.concat(

@@ -64,6 +64,17 @@ def redact_event(event: pb.StateEvent) -> pb.StateEvent:
         return pb.StateEvent(
             type=pb.EventPropose(request=replace(inner.request, data=b""))
         )
+    if isinstance(inner, pb.EventProposeBatch):
+        if not any(r.data for r in inner.requests):
+            return event
+        return pb.StateEvent(
+            type=pb.EventProposeBatch(
+                requests=[
+                    replace(r, data=b"") if r.data else r
+                    for r in inner.requests
+                ]
+            )
+        )
     if isinstance(inner, pb.EventStep) and isinstance(
         inner.msg.type if inner.msg else None, pb.ForwardRequest
     ):

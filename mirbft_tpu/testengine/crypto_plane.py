@@ -179,7 +179,65 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         rows = 1 << max(8, rows.bit_length() - 1)  # floor pow2, min 256
         return min(self.max_chunk_rows, rows)
 
+    def calibrate(self, probe_rows: int = 512) -> float:
+        """Measure the device round trip against host hashlib and set the
+        offload break-even threshold.
+
+        The plane is opportunistic (a demand never waits on the device),
+        so offloading only pays when a launch can finish before its wave
+        is demanded AND carries more digests than the host could compute
+        in one round-trip time.  Through a tunneled dev device the RTT is
+        tens of ms and the threshold lands in the tens of thousands
+        (digests stay host); on a directly attached chip it drops to a
+        few hundred.  Returns the measured RTT in seconds."""
+        import hashlib
+
+        import jax
+        import numpy as np
+
+        from ..ops.batching import pack_preimages
+
+        msgs = [bytes([i % 256]) * 64 for i in range(probe_rows)]
+        start = time.perf_counter()
+        for m in msgs:
+            hashlib.sha256(m).digest()
+        host_per_digest = (time.perf_counter() - start) / probe_rows
+
+        packed = pack_preimages(msgs, block_floor=1, batch_floor=1024)
+        blocks = jax.device_put(packed.blocks)
+        n = jax.device_put(packed.n_blocks)
+        np.asarray(self.kernel_fn(blocks, n))  # compile + warm
+        start = time.perf_counter()
+        packed = pack_preimages(msgs, block_floor=1, batch_floor=1024)
+        np.asarray(
+            self.kernel_fn(
+                jax.device_put(packed.blocks), jax.device_put(packed.n_blocks)
+            )
+        )
+        rtt = time.perf_counter() - start
+        # 1.5x safety: a launch below this row count loses to hashlib even
+        # if the result arrives in time.
+        self.min_device_rows = max(1024, int(1.5 * rtt / host_per_digest))
+        return rtt
+
+    # When the calibrated break-even exceeds any feasible wave, the whole
+    # deferral machinery is pure overhead: hash inline instead.
+    inline_threshold = 65536
+
     def submit(self, chunk_lists: list) -> list:
+        if self.min_device_rows >= self.inline_threshold:
+            # Device not worth it on this link (calibrate() measured an
+            # RTT the workload's wave sizes cannot amortize): behave like
+            # the reference's inline hasher, at hashlib speed.
+            import hashlib
+
+            out = [
+                hashlib.sha256(b"".join(chunks)).digest()
+                for chunks in chunk_lists
+            ]
+            self.host_digests += len(out)
+            return out
+
         from ..ops.batching import next_pow2, sha256_pad
 
         handles = []
@@ -190,7 +248,9 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
             bucket = next_pow2(len(sha256_pad(msg)) // 64)
             group = self._buckets.setdefault(bucket, [])
             group.append((index, msg))
-            if len(group) >= self.rows_for(bucket):
+            if len(group) >= self.rows_for(bucket) and len(group) >= (
+                self.min_device_rows
+            ):
                 self._launch(bucket, group)
                 self._buckets[bucket] = []
             handles.append(_Lazy(self, index))
@@ -269,11 +329,6 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
                 self._host_hash(group)
             self._buckets[bucket] = []
 
-    # A demand arriving sooner than this after its chunk's launch is served
-    # by host hashing rather than blocking on the (possibly still in
-    # flight) device round trip.
-    rescue_gap_s = 0.25
-
     def _resolve(self, index: int) -> bytes:
         digest = self._results.get(index)
         if digest is not None:
@@ -293,10 +348,12 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
             ready = words.is_ready()
         except AttributeError:
             ready = True  # non-jax arrays (tests): materialized already
-        if not ready and start - launched_at < self.rescue_gap_s:
-            # The round trip has not finished and too little wall time has
-            # passed to expect it soon: the engine would stall waiting.
-            # Recompute on the host (µs–ms) and let the device result drop.
+        if not ready:
+            # The round trip has not finished: never stall the event loop
+            # on the device.  Recompute on the host (µs–ms per digest) and
+            # let the device result drop — the offload is opportunistic;
+            # it only counts when it beats the demand.  (Values are
+            # identical either way, so determinism is unaffected.)
             import hashlib
 
             for i, msg in group:

@@ -238,6 +238,9 @@ class Recorder:
         self.initial_checkpoint_value = b""
 
         self.clients = {}
+        # Requests submitted at the current instant, awaiting the batched
+        # per-node propose flush (_flush_proposes).
+        self._pending_proposes: list = []
         # (client_id, req_no) -> [pb.Reconfiguration]: the deterministic
         # app-level reconfig model — when that request commits at a node,
         # the node's app reports the reconfigurations with its next
@@ -282,9 +285,11 @@ class Recorder:
             self._start_node(node, at_time=0)
             self._schedule(self.params.tick_interval, node, _tick_event())
 
-        # Clients submit their initial window of requests to every node.
+        # Clients submit their initial window of requests to every node —
+        # one batched delivery per node for the whole initial wave.
         for cid in client_ids:
             self.add_client(cid, reqs_per_client)
+        self._flush_proposes()
 
     # -- bootstrap -----------------------------------------------------------
 
@@ -496,7 +501,7 @@ class Recorder:
         )
         self._seq += 1
 
-    def _submit_next_request(self, client: _ClientState, at_delay: int) -> None:
+    def _submit_next_request(self, client: _ClientState) -> None:
         if client.next_req_no >= client.total_reqs:
             return
         request = client.request(client.next_req_no)
@@ -505,12 +510,70 @@ class Recorder:
             self.signature_plane.submit(
                 request.client_id, request.req_no, request.data
             )
+        # Proposals buffer and flush as one batched delivery per node per
+        # instant (see _flush_proposes) — the per-request propose fan-out
+        # (reqs x nodes single events) otherwise dominates event counts.
+        self._pending_proposes.append(request)
+
+    def _flush_proposes(self) -> None:
+        """Schedule everything _submit_next_request buffered at this
+        instant: one EventPropose(Batch) per node at +link_latency.  Called
+        at the end of __init__ (the initial client windows) and of every
+        step() (window refills triggered by commits); external callers that
+        submit between steps (tests) are flushed by the next step."""
+        pending = self._pending_proposes
+        if not pending:
+            return
+        self._pending_proposes = []
+        delay = self.params.link_latency
+        if self.manglers:
+            # Per-request fault-injection semantics: each request folds
+            # through the manglers as its own EventPropose candidate;
+            # survivors sharing a delivery instant re-coalesce.
+            for node in range(self.node_count):
+                state = self.node_states.get(node)
+                if state is not None and state.crashed:
+                    continue
+                when = self.now + delay
+                survivors: list = []
+                for request in pending:
+                    survivors.extend(
+                        self._mangle(
+                            [
+                                (
+                                    when,
+                                    node,
+                                    pb.StateEvent(
+                                        type=pb.EventPropose(request=request)
+                                    ),
+                                )
+                            ]
+                        )
+                    )
+                merged: dict = {}
+                for w, n, e in survivors:
+                    merged.setdefault((w, n), []).append(e)
+                for (w, n), events in merged.items():
+                    if len(events) == 1:
+                        event = events[0]
+                    else:
+                        event = pb.StateEvent(
+                            type=pb.EventProposeBatch(
+                                requests=[e.type.request for e in events]
+                            )
+                        )
+                    heapq.heappush(self._queue, (w, self._seq, n, event))
+                    self._seq += 1
+            return
+        if len(pending) == 1:
+            event = pb.StateEvent(type=pb.EventPropose(request=pending[0]))
+        else:
+            event = pb.StateEvent(type=pb.EventProposeBatch(requests=pending))
+        # One shared event object for every node, like delivery frames:
+        # propose events are never mutated (signature filtering builds a
+        # fresh event).
         for node in range(self.node_count):
-            self._schedule(
-                at_delay + self.params.link_latency,
-                node,
-                pb.StateEvent(type=pb.EventPropose(request=request)),
-            )
+            self._schedule(delay, node, event)
 
     # -- the loop ------------------------------------------------------------
 
@@ -546,17 +609,39 @@ class Recorder:
             pending = state.pending
             state.pending = act.Actions()
             self._execute(node, state, pending)
+            if self._pending_proposes:
+                # Commits in this pass refilled client windows; batch the
+                # new submissions into one delivery per node.
+                self._flush_proposes()
             return True
-        if self.signature_plane is not None and isinstance(
-            event.type, pb.EventPropose
-        ):
-            req = event.type.request
-            if not self.signature_plane.valid(
-                req.client_id, req.req_no, req.data
-            ):
-                # Ingress authentication failed: the replica never steps
-                # the state machine (unrecorded, like any dropped packet).
-                return True
+        if self.signature_plane is not None:
+            inner = event.type
+            if isinstance(inner, pb.EventPropose):
+                req = inner.request
+                if not self.signature_plane.valid(
+                    req.client_id, req.req_no, req.data
+                ):
+                    # Ingress authentication failed: the replica never
+                    # steps the state machine (unrecorded, like any
+                    # dropped packet).
+                    return True
+            elif isinstance(inner, pb.EventProposeBatch):
+                valid = self.signature_plane.valid
+                reqs = [
+                    r
+                    for r in inner.requests
+                    if valid(r.client_id, r.req_no, r.data)
+                ]
+                if not reqs:
+                    return True
+                if len(reqs) != len(inner.requests):
+                    # Never mutate the shared event object; the filtered
+                    # batch is what this replica (and the record) sees.
+                    # Verdicts are pure functions of the bytes, so every
+                    # replica filters identically.
+                    event = pb.StateEvent(
+                        type=pb.EventProposeBatch(requests=reqs)
+                    )
 
         self.event_count += 1
         if self.hash_plane is not None:
@@ -594,6 +679,10 @@ class Recorder:
                     ),
                 )
                 self._seq += 1
+        if self._pending_proposes:
+            # Commits in this event refilled client windows; batch the new
+            # submissions into one delivery per node at this instant.
+            self._flush_proposes()
         return True
 
     def _adopt_transferred_state(self, node: int, c_entry: pb.CEntry) -> None:
@@ -765,7 +854,7 @@ class Recorder:
         self._total_reqs_cache = None
         self._progress = True
         for _ in range(min(total_reqs, 100)):
-            self._submit_next_request(client, at_delay=0)
+            self._submit_next_request(client)
 
     def _apply_batch(self, node: int, state: NodeState, batch: pb.QEntry) -> None:
         state.last_committed = batch.seq_no
@@ -789,7 +878,7 @@ class Recorder:
                     # First commit anywhere slides the client's submission
                     # window (a deterministic stand-in for client waiters).
                     client.committed_anywhere.add(ack.req_no)
-                    self._submit_next_request(client, at_delay=0)
+                    self._submit_next_request(client)
 
     def _serve_state_transfer(self, node: int, target: act.StateTarget) -> None:
         for other in range(self.node_count):

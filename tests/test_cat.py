@@ -113,7 +113,9 @@ def test_actions_replay(tmp_path):
     # Pick a Propose event (emits a hash action) and a Step event.
     propose_idx = next(
         i for i, e in enumerate(events)
-        if isinstance(e.state_event.type, pb.EventPropose)
+        if isinstance(
+            e.state_event.type, (pb.EventPropose, pb.EventProposeBatch)
+        )
     )
     out = io.StringIO()
     assert main([path, "--actions-at", str(propose_idx)], out=out) == 0

@@ -306,7 +306,6 @@ def test_async_plane_rejects_before_launch():
     submit time without consuming kernel work."""
     from mirbft_tpu.testengine.signing import (
         AsyncSignaturePlane,
-        client_seed,
         make_signer,
         signing_message,
     )

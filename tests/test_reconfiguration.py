@@ -222,7 +222,7 @@ def test_node_set_reconfiguration_grow():
         rec.set_client_total(cid, 48)
         client = rec.clients[cid]
         for _ in range(8):
-            rec._submit_next_request(client, at_delay=0)
+            rec._submit_next_request(client)
     rec.drain_clients(max_steps=2_000_000)
 
     chains = {rec.node_states[n].app_chain for n in range(5)}
