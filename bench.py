@@ -305,6 +305,145 @@ def rung3_run():
     return total / wall, p99_ms, events, sum(plane.flush_sizes), stats
 
 
+RUNG4_NODES = 128
+RUNG4_CLIENTS = 32
+RUNG4_REQS = 16
+
+
+def rung4_run():
+    """BASELINE ladder rung 4: 128-node WAN (30ms frame jitter + an
+    early-window targeted drop mangler), 4 rotating leader buckets, BLS
+    checkpoint quorum certificates aggregated on device.
+
+    Returns (reqs/s, events, cert count, aggregate wall ms)."""
+    from mirbft_tpu import pb
+    from mirbft_tpu.testengine.certs import CheckpointCertPlane
+    from mirbft_tpu.testengine.engine import BasicRecorder, RuntimeParameters
+    from mirbft_tpu.testengine.manglers import (
+        from_source,
+        is_step,
+        percent,
+        rule,
+        until_time,
+    )
+
+    f = (RUNG4_NODES - 1) // 3
+    client_ids = [RUNG4_NODES + i for i in range(RUNG4_CLIENTS)]
+    state = pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(RUNG4_NODES)),
+            f=f,
+            number_of_buckets=4,
+            checkpoint_interval=20,
+            max_epoch_length=200,
+        ),
+        clients=[
+            pb.NetworkClient(id=c, width=16, low_watermark=0)
+            for c in client_ids
+        ],
+    )
+    certs = CheckpointCertPlane(quorum=2 * f + 1, use_device=True)
+    start = time.perf_counter()
+    rec = BasicRecorder(
+        RUNG4_NODES,
+        RUNG4_CLIENTS,
+        RUNG4_REQS,
+        batch_size=20,
+        network_state=state,
+        record=False,
+        checkpoint_certs=certs,
+        params=RuntimeParameters(link_jitter=30),
+        # Targeted fault: half of node 120's frames die in the first two
+        # simulated seconds (cheap to fold, recovers via rebroadcast).
+        manglers=[
+            rule(
+                from_source(120), is_step(), percent(50), until_time(2000)
+            ).drop()
+        ],
+    )
+    rec.drain_clients(max_steps=20_000_000)
+    # Run on until at least one checkpoint quorum has formed.
+    extra = 0
+    while not (certs._pending or certs._certs) and extra < 2_000_000:
+        rec.step()
+        extra += 1
+    wall = time.perf_counter() - start
+    chains = {rec.node_states[n].app_chain for n in range(RUNG4_NODES)}
+    assert len(chains) == 1, "rung-4 nodes diverged!"
+    total = RUNG4_CLIENTS * RUNG4_REQS
+    start = time.perf_counter()
+    certificates = certs.certificates()
+    agg_ms = 1e3 * (time.perf_counter() - start)
+    assert certificates, "no checkpoint certificates formed"
+    (seq, value), (signers, asig) = sorted(certificates.items())[0]
+    assert CheckpointCertPlane.verify(seq, value, signers, asig)
+    assert not CheckpointCertPlane.verify(seq, value + b"!", signers, asig)
+    return total / wall, rec.event_count, len(certificates), agg_ms
+
+
+RUNG5_NODES = 256
+RUNG5_CLIENTS = 1024
+RUNG5_REQS = 1
+
+
+def rung5_run():
+    """BASELINE ladder rung 5, scaled to the single-process Python
+    budget: 256 nodes f=85 under WAN jitter, 1024 clients, and a
+    state-transfer storm ingredient (a follower crashes mid-run, stays
+    down past checkpoint GC, restarts, and must recover).  The full
+    10k-client + forced-epoch-change storm runs as the HEAVY-gated
+    correctness tests (tests/test_testengine.py): a 256-node epoch
+    change is ~n^3 messages and exceeds any reasonable bench budget on
+    the host event loop.
+
+    Returns (reqs/s, events)."""
+    from mirbft_tpu import pb
+    from mirbft_tpu.testengine.engine import BasicRecorder, RuntimeParameters
+
+    f = (RUNG5_NODES - 1) // 3
+    client_ids = [RUNG5_NODES + i for i in range(RUNG5_CLIENTS)]
+    state = pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(RUNG5_NODES)),
+            f=f,
+            number_of_buckets=4,
+            checkpoint_interval=20,
+            max_epoch_length=200,
+        ),
+        clients=[
+            pb.NetworkClient(id=c, width=2, low_watermark=0)
+            for c in client_ids
+        ],
+    )
+    start = time.perf_counter()
+    rec = BasicRecorder(
+        RUNG5_NODES,
+        RUNG5_CLIENTS,
+        RUNG5_REQS,
+        batch_size=200,
+        network_state=state,
+        record=False,
+        params=RuntimeParameters(link_jitter=20),
+    )
+    # Storm ingredient: a follower dies mid-run, misses checkpoint GC,
+    # and must state-transfer back in.
+    for _ in range(20_000):
+        rec.step()
+    rec.crash(200)
+    for _ in range(40_000):
+        rec.step()
+    rec.schedule_restart(200, delay=0)
+    events = rec.drain_clients(max_steps=50_000_000)
+    wall = time.perf_counter() - start
+    chains = {rec.node_states[n].app_chain for n in range(RUNG5_NODES)}
+    assert len(chains) == 1, "rung-5 nodes diverged!"
+    total = RUNG5_CLIENTS * RUNG5_REQS
+    assert all(
+        rec.committed_at(n) == total for n in range(RUNG5_NODES)
+    ), "rung-5 missing commits"
+    return total / wall, events
+
+
 def main():
     _enable_compile_cache()
     from mirbft_tpu.testengine.crypto_plane import AsyncKernelHashPlane
@@ -331,6 +470,8 @@ def main():
     rung3_rate, rung3_p99, rung3_events, rung3_verified, rung3_stats = (
         rung3_run()
     )
+    rung4_rate, rung4_events, rung4_certs, rung4_agg_ms = rung4_run()
+    rung5_rate, rung5_events = rung5_run()
 
     total_reqs = CLIENTS * REQS_PER_CLIENT
     committed_rate = total_reqs / tpu_wall
@@ -395,6 +536,28 @@ def main():
                 "rung3_engine_events": rung3_events,
                 "rung3_verified_requests": rung3_verified,
                 **rung3_stats,
+                # BASELINE ladder rung 4: 128-node WAN (frame jitter +
+                # targeted drop mangler), BLS quorum certs on device.
+                "rung4_committed_reqs_per_sec": round(rung4_rate, 1),
+                "rung4_config": (
+                    f"{RUNG4_NODES} nodes f={(RUNG4_NODES - 1) // 3}, "
+                    f"{RUNG4_CLIENTS} clients, 30ms WAN jitter + drop "
+                    "mangler, BLS checkpoint certs aggregated on device"
+                ),
+                "rung4_engine_events": rung4_events,
+                "rung4_bls_certificates": rung4_certs,
+                "rung4_bls_aggregate_ms": round(rung4_agg_ms, 2),
+                # BASELINE ladder rung 5 (scaled; see rung5_run docstring):
+                # 256-node WAN + follower crash/state-transfer recovery.
+                "rung5_committed_reqs_per_sec": round(rung5_rate, 1),
+                "rung5_config": (
+                    f"{RUNG5_NODES} nodes f={(RUNG5_NODES - 1) // 3}, "
+                    f"{RUNG5_CLIENTS} clients, 20ms WAN jitter, follower "
+                    "crash + checkpoint-GC + state-transfer recovery "
+                    "(10k-client epoch-change storm runs as the "
+                    "HEAVY-gated correctness tier)"
+                ),
+                "rung5_engine_events": rung5_events,
             }
         )
     )

@@ -161,19 +161,53 @@ class AvailableList:
 # ---------------------------------------------------------------------------
 
 
-@dataclass(slots=True)
 class ClientRequest:
-    ack: pb.RequestAck
-    # Node IDs acking this digest, as a bitmask over node id (bit i set =
-    # node i acked).  Node ids come from the replicated config and are
-    # small in practice; int masks turn the hottest per-ack bookkeeping
-    # (membership test, insert, cardinality) into single int ops.
-    agreements: int = 0
-    garbage: bool = False  # some request for this (client, req_no) committed
-    stored: bool = False  # persisted locally
-    fetching: bool = False
-    ticks_fetching: int = 0
-    ticks_correct: int = 0
+    """One candidate request (digest) for a (client, req_no).
+
+    ``agreements`` is the node-id bitmask of ackers (bit i = node i
+    acked).  While the request is the canonical entry of a _FastAcks
+    mirror slot, the mask lives in the mirror's uint64 limb arrays and
+    the property reads/writes through — one storage, no sync loops; when
+    detached (mirror dropped, conflict, GC) the value materializes back
+    into ``_agreements``."""
+
+    __slots__ = (
+        "ack",
+        "_agreements",
+        "_owner",
+        "_slot",
+        "garbage",
+        "stored",
+        "fetching",
+        "ticks_fetching",
+        "ticks_correct",
+    )
+
+    def __init__(self, ack: pb.RequestAck, agreements: int = 0):
+        self.ack = ack
+        self._agreements = agreements
+        self._owner = None  # the owning _FastAcks while mirrored
+        self._slot = 0
+        self.garbage = False  # some request for this (client, req_no) committed
+        self.stored = False  # persisted locally
+        self.fetching = False
+        self.ticks_fetching = 0
+        self.ticks_correct = 0
+
+    @property
+    def agreements(self) -> int:
+        owner = self._owner
+        if owner is None:
+            return self._agreements
+        return owner.combine_agree(self._slot)
+
+    @agreements.setter
+    def agreements(self, value: int) -> None:
+        owner = self._owner
+        if owner is None:
+            self._agreements = value
+        else:
+            owner.set_agree(self._slot, value)
 
     def fetch(self) -> Actions:
         if self.fetching:
@@ -203,7 +237,9 @@ class ClientReqNo:
         "valid_after_seq_no",
         "network_config",
         "committed",
-        "non_null_voters",
+        "_non_null_voters",
+        "_nn_owner",
+        "_nn_slot",
         "requests",
         "weak_requests",
         "strong_requests",
@@ -227,7 +263,12 @@ class ClientReqNo:
         self.valid_after_seq_no = valid_after_seq_no
         self.network_config = network_config
         self.committed = committed
-        self.non_null_voters: int = 0  # bitmask over node id
+        # Non-null-voter bitmask; like ClientRequest.agreements it lives in
+        # the _FastAcks limb arrays while this req_no is a canonical
+        # mirror slot and reads/writes through the property.
+        self._nn_owner = None
+        self._nn_slot = 0
+        self.non_null_voters = 0  # bitmask over node id
         self.requests: dict[bytes, ClientRequest] = {}  # all observed
         self.weak_requests: dict[bytes, ClientRequest] = {}  # f+1 correct
         self.strong_requests: dict[bytes, ClientRequest] = {}  # 2f+1
@@ -242,6 +283,21 @@ class ClientReqNo:
         else:
             # Set by reinitialize() before any ack can be applied.
             self._weak_quorum = self._strong_quorum = None
+
+    @property
+    def non_null_voters(self) -> int:
+        owner = self._nn_owner
+        if owner is None:
+            return self._non_null_voters
+        return owner.combine_nonnull(self._nn_slot)
+
+    @non_null_voters.setter
+    def non_null_voters(self, value: int) -> None:
+        owner = self._nn_owner
+        if owner is None:
+            self._non_null_voters = value
+        else:
+            owner.set_nonnull(self._nn_slot, value)
 
     def reinitialize(self, network_config: pb.NetworkConfig) -> None:
         self.network_config = network_config
@@ -515,6 +571,7 @@ class _FastAcks:
     SLOW = 2
 
     __slots__ = (
+        "limbs",
         "cid0",
         "n_clients",
         "offset_arr",
@@ -543,6 +600,8 @@ class _FastAcks:
     def __init__(self, tracker: "ClientTracker"):
         import numpy as np
 
+        # uint64 limbs per node-id mask (limb i covers ids [64i, 64i+64)).
+        self.limbs = tracker._mask_limbs
         clients = tracker.clients
         cids = sorted(clients)
         self.cid0 = cids[0]
@@ -571,8 +630,8 @@ class _FastAcks:
             metas.append((client, ci, total, size))
             total += size
 
-        self.agree = np.zeros(total, dtype=np.uint64)
-        self.nonnull = np.zeros(total, dtype=np.uint64)
+        self.agree = np.zeros((total, self.limbs), dtype=np.uint64)
+        self.nonnull = np.zeros((total, self.limbs), dtype=np.uint64)
         self.flags = np.zeros(total, dtype=np.uint8)
         self.canon_mat = np.zeros((total, 32), dtype=np.uint8)
         self.canon_ok = np.zeros(total, dtype=bool)
@@ -612,6 +671,7 @@ class _FastAcks:
         tick_l = [0] * total
         tsa_l = [0] * total
         tgt_l = [0] * total
+        attach_list = []
         canon_req = self.canon_req
         canon_crn = self.canon_crn
         for client, ci, offset, size in metas:
@@ -637,6 +697,7 @@ class _FastAcks:
                     canon_req[slot] = req
                     agree_l[slot] = req.agreements
                     nonnull_l[slot] = crn.non_null_voters
+                    attach_list.append((slot, req, crn))
                 else:
                     flags_l[slot] = self.SLOW
                 tick_cls = self._classify_tick(crn)
@@ -644,8 +705,13 @@ class _FastAcks:
                 if tick_cls == self.TICK_STEADY:
                     tsa_l[slot] = crn.ticks_since_ack
                     tgt_l[slot] = crn.acks_sent * _ACK_RESEND_TICKS
-        self.agree[:] = agree_l
-        self.nonnull[:] = nonnull_l
+        mask64 = (1 << 64) - 1
+        for limb in range(self.limbs):
+            shift = 64 * limb
+            self.agree[:, limb] = [(v >> shift) & mask64 for v in agree_l]
+            self.nonnull[:, limb] = [
+                (v >> shift) & mask64 for v in nonnull_l
+            ]
         self.flags[:] = flags_l
         self.canon_ok[:] = ok_l
         self.tick_class[:] = tick_l
@@ -654,6 +720,85 @@ class _FastAcks:
         self.canon_mat[:] = np.frombuffer(
             b"".join(dig_l), dtype=np.uint8
         ).reshape(total, 32)
+        # Attach canonical objects to their slots (arrays already seeded
+        # by the column writes above): their mask properties now read and
+        # write through this mirror.
+        for slot, req, crn in attach_list:
+            req._owner = self
+            req._slot = slot
+            crn._nn_owner = self
+            crn._nn_slot = slot
+
+    def combine_agree(self, slot: int) -> int:
+        if self.limbs == 1:
+            return int(self.agree[slot, 0])
+        value = 0
+        for limb in range(self.limbs - 1, -1, -1):
+            value = (value << 64) | int(self.agree[slot, limb])
+        return value
+
+    def set_agree(self, slot: int, value: int) -> None:
+        if self.limbs == 1:
+            self.agree[slot, 0] = value
+            return
+        mask64 = (1 << 64) - 1
+        for limb in range(self.limbs):
+            self.agree[slot, limb] = (value >> (64 * limb)) & mask64
+
+    def combine_nonnull(self, slot: int) -> int:
+        if self.limbs == 1:
+            return int(self.nonnull[slot, 0])
+        value = 0
+        for limb in range(self.limbs - 1, -1, -1):
+            value = (value << 64) | int(self.nonnull[slot, limb])
+        return value
+
+    def set_nonnull(self, slot: int, value: int) -> None:
+        if self.limbs == 1:
+            self.nonnull[slot, 0] = value
+            return
+        mask64 = (1 << 64) - 1
+        for limb in range(self.limbs):
+            self.nonnull[slot, limb] = (value >> (64 * limb)) & mask64
+
+    def _attach(self, slot: int, req, crn) -> None:
+        """Make this mirror slot the storage for the canonical request's
+        agreements and the crn's non-null-voter mask (the properties on
+        those objects read/write through while attached)."""
+        if req._owner is not self or req._slot != slot:
+            value = req._agreements if req._owner is None else req.agreements
+            req._owner = self
+            req._slot = slot
+            self.set_agree(slot, value)
+        if crn._nn_owner is not self or crn._nn_slot != slot:
+            value = (
+                crn._non_null_voters
+                if crn._nn_owner is None
+                else crn.non_null_voters
+            )
+            crn._nn_owner = self
+            crn._nn_slot = slot
+            self.set_nonnull(slot, value)
+
+    def _detach(self, slot: int) -> None:
+        req = self.canon_req[slot]
+        if req is not None and req._owner is self and req._slot == slot:
+            req._agreements = self.combine_agree(slot)
+            req._owner = None
+        crn = self.canon_crn[slot]
+        if (
+            crn is not None
+            and crn._nn_owner is self
+            and crn._nn_slot == slot
+        ):
+            crn._non_null_voters = self.combine_nonnull(slot)
+            crn._nn_owner = None
+
+    def detach_all(self) -> None:
+        """Materialize every attached mask back into its object (before
+        the mirror is dropped or rebuilt)."""
+        for slot in range(len(self.canon_req)):
+            self._detach(slot)
 
     def drain_tick_dirty(self) -> None:
         """Push deferred ack activity into the clients' _tick_pending sets
@@ -725,33 +870,46 @@ class _FastAcks:
                 old_crn.ticks_since_ack = int(self.tsa[slot])
 
         if crn is None:
+            self._detach(slot)
             self.flags[slot] = self.SLOW
             self.canon_crn[slot] = None
             self.canon_req[slot] = None
             self.canon_ok[slot] = False
             self.tick_class[slot] = self.TICK_INERT
             return
-        self.canon_crn[slot] = crn
-        if crn.committed is not None:
-            self.flags[slot] = self.COMMITTED
-            self.tick_class[slot] = self.TICK_INERT
-            return
         requests = crn.requests
-        if len(requests) == 1 and _NULL not in requests:
+        canonical = (
+            crn.committed is None
+            and len(requests) == 1
+            and _NULL not in requests
+        )
+        if canonical:
             (digest,) = requests
             req = requests[digest]
+            old_req = self.canon_req[slot]
+            if old_req is not None and old_req is not req:
+                self._detach(slot)
+            self.canon_crn[slot] = crn
             self.canon_mat_dirty.append((slot, digest))
             self.canon_ok[slot] = True
             self.canon_req[slot] = req
-            self.agree[slot] = req.agreements
-            self.nonnull[slot] = crn.non_null_voters
+            # The slot becomes (or stays) the live storage for the masks;
+            # the objects' properties read/write through it.
+            self._attach(slot, req, crn)
             self.flags[slot] = 0
         else:
-            # No votes yet (first ack adopts its digest via the per-row
-            # fallback, which then refreshes this slot), or conflicting
-            # digests / a null request in play.
+            # Committed, no votes yet (first ack adopts its digest via the
+            # per-row fallback, which then refreshes this slot), or
+            # conflicting digests / a null request in play: masks move
+            # back to the objects.
+            self._detach(slot)
+            self.canon_crn[slot] = crn
             self.canon_ok[slot] = False
             self.canon_req[slot] = None
+            if crn.committed is not None:
+                self.flags[slot] = self.COMMITTED
+                self.tick_class[slot] = self.TICK_INERT
+                return
             self.flags[slot] = self.SLOW
         self.tick_class[slot] = self._classify_tick(crn)
         if self.tick_class[slot] == self.TICK_STEADY:
@@ -1064,6 +1222,7 @@ class ClientTracker:
         # step_ack_many when the config supports it.
         self._fast: _FastAcks | None = None
         self._fast_ok = False
+        self._mask_limbs = 1
 
     def _drop_fast(self) -> None:
         """Invalidate the columnar mirror (draining deferred tick activity
@@ -1072,6 +1231,7 @@ class ClientTracker:
         if self._fast is not None:
             self._fast.drain_tick_dirty()
             self._fast.writeback_tick()
+            self._fast.detach_all()
             self._fast = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -1138,13 +1298,15 @@ class ClientTracker:
                 )
             self.msg_buffers[node_id] = buffer
 
-        # The vector ack path needs every node id in a uint64 mask and a
+        # The vector ack path splits node-id masks into uint64 limbs
+        # (one frame only ever touches its source's limb) and needs a
         # dense-ish client id range (the mirror indexes [cid0, cid_last]).
         nodes = self.network_config.nodes
         cids = [cs.id for cs in self.client_states]
+        self._mask_limbs = ((max(nodes) >> 6) + 1) if nodes else 1
         self._fast_ok = bool(
             nodes
-            and max(nodes) < 64
+            and self._mask_limbs <= 8  # up to 512-node ids
             and cids
             and (max(cids) - min(cids) + 1) <= 4 * len(cids) + 1024
         )
@@ -1319,10 +1481,14 @@ class ClientTracker:
 
         vrows = np.flatnonzero(vec)
         if len(vrows):
-            bit = np.uint64(1 << source)
+            # One frame carries one source, so only that source's mask
+            # limb is touched — the hot path stays single-limb at any
+            # network size.
+            limb = source >> 6
+            bit = np.uint64(1 << (source & 63))
             vslot = slot[vrows]
-            old = fast.agree[vslot]
-            nn = fast.nonnull[vslot]
+            old = fast.agree[vslot, limb]
+            nn = fast.nonnull[vslot, limb]
             dup = (old & bit) != np.uint64(0)
             # A voter whose non-null vote went to a different digest gets
             # no second vote (the spam guard).
@@ -1334,23 +1500,24 @@ class ClientTracker:
             ap_slots = vslot[ap]
             # Duplicate slots within one frame all OR the same source bit,
             # so last-write-wins scatter is exact.
-            fast.agree[ap_slots] = new[ap]
-            fast.nonnull[ap_slots] = nn_new[ap]
+            fast.agree[ap_slots, limb] = new[ap]
+            fast.nonnull[ap_slots, limb] = nn_new[ap]
             fast.tick_dirty[ap_slots] = True
 
-            counts = np.bitwise_count(new)
+            if fast.limbs == 1:
+                counts = np.bitwise_count(new)
+            else:
+                # Full-row popcount (post-scatter: duplicate slots carry
+                # identical final values).
+                counts = np.bitwise_count(fast.agree[vslot]).sum(
+                    axis=1, dtype=np.int64
+                )
+            # No object writeback: the canonical request/crn masks READ
+            # AND WRITE through the mirror arrays while attached (see
+            # ClientRequest.agreements / ClientReqNo.non_null_voters).
             changed = apply_m & ~dup
-            # Object writeback: the mirror is authoritative only inside
-            # this call.
             canon_req = fast.canon_req
             canon_crn = fast.canon_crn
-            ch = np.flatnonzero(changed)
-            ch_slots = vslot[ch].tolist()
-            ch_agree = new[ch].tolist()
-            ch_nn = nn_new[ch].tolist()
-            for s, a, v in zip(ch_slots, ch_agree, ch_nn):
-                canon_req[s].agreements = a
-                canon_crn[s].non_null_voters = v
 
             # Quorum crossings (one bit per frame per slot: equality is
             # exact).  Rare relative to acks — plain Python per crossing.
@@ -1370,8 +1537,20 @@ class ClientTracker:
                     crn.weak_requests[req.ack.digest] = req
                     available_push(req)
                     # Weak membership feeds the tick classification (an
-                    # unstored newly-weak request needs fetch ticks).
-                    fast._refresh_slot(s, crn)
+                    # unstored newly-weak request needs fetch ticks); the
+                    # canonical mirror state is untouched by the crossing,
+                    # so only the tick class is re-derived.
+                    old_cls = fast.tick_class[s]
+                    new_cls = fast._classify_tick(crn)
+                    if new_cls != old_cls:
+                        if old_cls == _FastAcks.TICK_STEADY:
+                            crn.ticks_since_ack = int(fast.tsa[s])
+                        fast.tick_class[s] = new_cls
+                        if new_cls == _FastAcks.TICK_STEADY:
+                            fast.tsa[s] = crn.ticks_since_ack
+                            fast.tgt[s] = (
+                                crn.acks_sent * _ACK_RESEND_TICKS
+                            )
             strong_cross = np.flatnonzero(changed & (counts == fast.strong_q))
             if len(strong_cross):
                 for j in strong_cross.tolist():

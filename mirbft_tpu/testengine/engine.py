@@ -67,6 +67,13 @@ class RuntimeParameters:
     process_latency: int = 10
     persist_latency: int = 10
     state_transfer_latency: int = 800
+    # WAN delay variance, applied per delivered frame (uniform in
+    # [0, link_jitter], drawn from the engine's seeded rng).  Frame-level
+    # because a frame models one transport segment: per-msg jitter (the
+    # manglers' fault-injection semantics) would tear every coalesced
+    # delivery into individual events, which is neither how packet delay
+    # variation behaves nor affordable at pod scale.
+    link_jitter: int = 0
 
 
 def standard_initial_network_state(
@@ -387,6 +394,8 @@ class Recorder:
         if state is not None and state.crashed:
             return  # a down node loses its inbound traffic
         when = self.now + delay
+        if self.params.link_jitter:
+            when += self.rng.randint(0, self.params.link_jitter)
         survivors: list = []
         for msg in msgs:
             survivors.extend(
@@ -781,6 +790,8 @@ class Recorder:
                         send_delay, node, target, msgs
                     )
         else:
+            jitter = self.params.link_jitter
+            rand = self.rng.randint
             for targets, msgs in groups.items():
                 if len(msgs) == 1:
                     event = pb.StateEvent(
@@ -790,8 +801,14 @@ class Recorder:
                     event = pb.StateEvent(
                         type=pb.EventStepBatch(source=node, msgs=msgs)
                     )
-                for target in targets:
-                    self._schedule(send_delay, target, event)
+                if jitter:
+                    for target in targets:
+                        self._schedule(
+                            send_delay + rand(0, jitter), target, event
+                        )
+                else:
+                    for target in targets:
+                        self._schedule(send_delay, target, event)
 
         results = act.ActionResults()
         if actions.hashes:
