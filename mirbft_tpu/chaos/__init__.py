@@ -1,0 +1,58 @@
+"""Chaos campaign harness: seeded fault-scenario matrix + invariants.
+
+The testengine's mangler DSL (testengine/manglers.py) injects individual
+faults; this package turns it into a *campaign*: a reproducible matrix of
+scenarios — message loss, jitter, duplication, crash + restart schedules,
+network partitions with heal, and device-plane faults against the crypto
+planes — each executed under a seeded Recorder and then audited by an
+invariant checker:
+
+- **No fork**: committed prefixes agree across nodes (any two nodes that
+  committed a sequence number committed the same requests there, in the
+  same order).
+- **Durability**: a crashed node's post-replay commit log is a
+  prefix-consistent continuation of what it had committed before the
+  crash.
+- **Bounded recovery**: the run converges within a bound of the last
+  disruption (partition heal / node restart) — liveness degrades, never
+  dies.
+
+Entry points::
+
+    python -m mirbft_tpu.chaos                 # full matrix
+    python -m mirbft_tpu.chaos --smoke         # the tier-1 subset
+    python -m mirbft_tpu.chaos --seed 7 --only partition
+
+See docs/CHAOS.md for the scenario catalogue.
+"""
+
+from .faults import FlakyDigestBackend
+from .invariants import (
+    CrashSnapshot,
+    InvariantViolation,
+    check_bounded_recovery,
+    check_durable_prefix,
+    check_full_convergence,
+    check_no_fork,
+)
+from .runner import CampaignResult, ScenarioResult, run_campaign, run_scenario
+from .scenarios import SMOKE_NAMES, CrashPoint, Scenario, matrix, smoke_matrix
+
+__all__ = [
+    "CampaignResult",
+    "CrashPoint",
+    "CrashSnapshot",
+    "FlakyDigestBackend",
+    "InvariantViolation",
+    "Scenario",
+    "ScenarioResult",
+    "SMOKE_NAMES",
+    "check_bounded_recovery",
+    "check_durable_prefix",
+    "check_full_convergence",
+    "check_no_fork",
+    "matrix",
+    "run_campaign",
+    "run_scenario",
+    "smoke_matrix",
+]

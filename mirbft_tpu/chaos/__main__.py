@@ -1,0 +1,54 @@
+"""CLI entry: ``python -m mirbft_tpu.chaos [--seed N] [--smoke] [--only S]``.
+
+Exit status 0 iff every selected scenario passed all invariants."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .runner import run_campaign
+from .scenarios import matrix, smoke_matrix
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mirbft_tpu.chaos",
+        description="Seeded chaos campaign over the mirbft-tpu testengine.",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=0, help="campaign base seed (default 0)"
+    )
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="run only the tier-1 smoke subset (3 scenarios)",
+    )
+    parser.add_argument(
+        "--only",
+        default=None,
+        help="run only scenarios whose name contains this substring",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list scenarios and exit"
+    )
+    args = parser.parse_args(argv)
+
+    scenarios = smoke_matrix() if args.smoke else matrix()
+    if args.only:
+        scenarios = [s for s in scenarios if args.only in s.name]
+    if not scenarios:
+        print("no scenarios match", file=sys.stderr)
+        return 2
+    if args.list:
+        for scenario in scenarios:
+            print(f"{scenario.name:<28} {scenario.description}")
+        return 0
+
+    campaign = run_campaign(scenarios, seed=args.seed)
+    print(campaign.report())
+    return 0 if campaign.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

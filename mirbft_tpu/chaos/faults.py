@@ -1,0 +1,65 @@
+"""Device-plane fault injection for the chaos campaign.
+
+The crypto planes (testengine/crypto_plane.py, testengine/signing.py) take
+a pluggable backend; ``FlakyDigestBackend`` wraps one with a deterministic
+call-indexed failure window so a scenario can make the "device" die, lie
+(short reads), or hang (exceed the plane's deadline) for a stretch of the
+run and then recover — exercising the circuit breaker's trip → fallback →
+probe → re-close cycle without any wall-clock nondeterminism in *what*
+fails (only call indices decide)."""
+
+from __future__ import annotations
+
+import time
+
+from ..testengine.crypto_plane import DevicePlaneError, _host_digest_many
+
+MODES = ("die", "short", "slow")
+
+
+class FlakyDigestBackend:
+    """A ``digest_many``-compatible callable that misbehaves for calls
+    ``fail_from <= i < fail_until`` (0-indexed) and is healthy otherwise.
+
+    Modes:
+
+    - ``die``:   raise DevicePlaneError (device lost mid-wave).
+    - ``short``: return half the digests (a lying readback).
+    - ``slow``:  sleep ``delay_s`` before answering correctly — pair with
+      a plane ``timeout_s`` below ``delay_s`` so the breaker counts it.
+
+    While the plane's breaker is open the backend is only reached by
+    probes, so the call index — and therefore the recovery point — stays
+    deterministic for a given scenario.
+    """
+
+    def __init__(
+        self,
+        fail_from: int = 0,
+        fail_until: int = 0,
+        mode: str = "die",
+        delay_s: float = 0.002,
+        backend=None,
+    ):
+        assert mode in MODES, f"mode must be one of {MODES}"
+        self.fail_from = fail_from
+        self.fail_until = fail_until
+        self.mode = mode
+        self.delay_s = delay_s
+        self.backend = backend if backend is not None else _host_digest_many
+        self.calls = 0
+        self.injected = 0
+
+    def __call__(self, msgs: list) -> list:
+        index = self.calls
+        self.calls += 1
+        if self.fail_from <= index < self.fail_until:
+            self.injected += 1
+            if self.mode == "die":
+                raise DevicePlaneError(
+                    f"injected device loss (call {index})"
+                )
+            if self.mode == "short":
+                return self.backend(msgs)[: len(msgs) // 2]
+            time.sleep(self.delay_s)
+        return self.backend(msgs)

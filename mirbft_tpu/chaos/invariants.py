@@ -1,0 +1,118 @@
+"""Safety and liveness invariants asserted after every chaos scenario.
+
+All checks read only harness-side state (``NodeState.committed_reqs``,
+``app_chain``) — the same evidence the reference's testengine audits —
+so they hold for any Recorder configuration (manglers, planes, signed
+mode) without instrumenting the protocol."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class InvariantViolation(AssertionError):
+    """A chaos invariant failed; the message names scenario evidence."""
+
+
+@dataclass
+class CrashSnapshot:
+    """What a node had durably committed the instant it was crashed."""
+
+    node: int
+    at_ms: int
+    committed: list = field(default_factory=list)  # [(client, req_no, seq)]
+
+
+def committed_by_seq(committed_reqs: list) -> dict:
+    """[(client, req_no, seq)] -> {seq: ((client, req_no), ...)} preserving
+    the within-batch commit order."""
+    by_seq: dict = {}
+    for client, req_no, seq in committed_reqs:
+        by_seq.setdefault(seq, []).append((client, req_no))
+    return {seq: tuple(reqs) for seq, reqs in by_seq.items()}
+
+
+def check_no_fork(rec) -> dict:
+    """Committed prefixes agree: every sequence number committed anywhere
+    was committed with identical request content (and order) everywhere it
+    was committed; per node, commits are seq-ordered and no request
+    commits twice.  Returns the canonical {seq: requests} map."""
+    canonical: dict = {}
+    owner: dict = {}
+    for node in range(rec.node_count):
+        reqs = rec.node_states[node].committed_reqs
+        seqs = [seq for _c, _q, seq in reqs]
+        if seqs != sorted(seqs):
+            raise InvariantViolation(
+                f"node {node} committed out of seq order: {seqs}"
+            )
+        pairs = [(c, q) for c, q, _s in reqs]
+        if len(pairs) != len(set(pairs)):
+            dupes = {p for p in pairs if pairs.count(p) > 1}
+            raise InvariantViolation(
+                f"node {node} committed requests twice: {sorted(dupes)}"
+            )
+        for seq, batch in committed_by_seq(reqs).items():
+            if seq not in canonical:
+                canonical[seq] = batch
+                owner[seq] = node
+            elif canonical[seq] != batch:
+                raise InvariantViolation(
+                    f"fork at seq {seq}: node {owner[seq]} committed "
+                    f"{canonical[seq]}, node {node} committed {batch}"
+                )
+    return canonical
+
+
+def check_durable_prefix(rec, snapshots: list) -> None:
+    """Everything a node committed before its crash survives the replay:
+    the pre-crash commit log is a strict prefix of the node's final log
+    (the post-restart history *continues* it, never rewrites it)."""
+    for snap in snapshots:
+        final = rec.node_states[snap.node].committed_reqs
+        if len(final) < len(snap.committed):
+            raise InvariantViolation(
+                f"node {snap.node} lost commits across restart: had "
+                f"{len(snap.committed)} at crash (t={snap.at_ms}ms), "
+                f"has {len(final)} after recovery"
+            )
+        prefix = final[: len(snap.committed)]
+        if prefix != snap.committed:
+            for i, (pre, post) in enumerate(zip(snap.committed, prefix)):
+                if pre != post:
+                    raise InvariantViolation(
+                        f"node {snap.node} rewrote durable history at "
+                        f"commit {i}: {pre} became {post}"
+                    )
+
+
+def check_full_convergence(rec) -> None:
+    """Every node (including restarted ones) committed every request and
+    the application hash chains agree — the end-state the drain targets."""
+    total = sum(c.total_reqs for c in rec.clients.values())
+    for node in range(rec.node_count):
+        if rec.node_states[node].crashed:
+            raise InvariantViolation(f"node {node} still down at drain end")
+        got = rec.committed_at(node)
+        if got < total:
+            raise InvariantViolation(
+                f"node {node} committed {got}/{total} requests"
+            )
+    chains = {rec.node_states[n].app_chain for n in range(rec.node_count)}
+    if len(chains) != 1:
+        raise InvariantViolation(
+            f"app chains diverge across nodes: {len(chains)} distinct"
+        )
+
+
+def check_bounded_recovery(
+    completion_ms: int, last_disruption_end_ms: int, bound_ms: int
+) -> None:
+    """Liveness resumed: the run reached full commitment within
+    ``bound_ms`` of simulated time after the last heal/restart instant."""
+    lag = completion_ms - max(last_disruption_end_ms, 0)
+    if lag > bound_ms:
+        raise InvariantViolation(
+            f"recovery took {lag}ms of simulated time after the last "
+            f"disruption ended (bound: {bound_ms}ms)"
+        )

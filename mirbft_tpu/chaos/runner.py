@@ -1,0 +1,180 @@
+"""Campaign runner: executes scenarios on the testengine and audits them.
+
+The runner drives a custom drain loop (instead of ``drain_clients``) so it
+can fire runner-driven crash points at simulated instants — snapshotting
+each victim's durable commit log first, which is what gives the durability
+invariant its ground truth — and record when commitment progress happens,
+which is what gives bounded-recovery its evidence."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..testengine.engine import BasicRecorder
+from .invariants import (
+    CrashSnapshot,
+    InvariantViolation,
+    check_bounded_recovery,
+    check_durable_prefix,
+    check_full_convergence,
+    check_no_fork,
+)
+from .scenarios import Scenario, matrix
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    seed: int
+    passed: bool
+    events: int = 0
+    sim_ms: int = 0
+    commits: int = 0
+    violation: str = ""
+    counters: dict = field(default_factory=dict)
+
+    def line(self) -> str:
+        status = "ok  " if self.passed else "FAIL"
+        extra = "".join(
+            f" {key}={value}" for key, value in sorted(self.counters.items())
+        )
+        tail = f" [{self.violation}]" if self.violation else ""
+        return (
+            f"{status} {self.name:<28} seed={self.seed} "
+            f"events={self.events} sim={self.sim_ms}ms "
+            f"commits={self.commits}{extra}{tail}"
+        )
+
+
+@dataclass
+class CampaignResult:
+    seed: int
+    results: list = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return all(r.passed for r in self.results)
+
+    def report(self) -> str:
+        lines = [r.line() for r in self.results]
+        good = sum(r.passed for r in self.results)
+        lines.append(
+            f"campaign seed={self.seed}: {good}/{len(self.results)} "
+            f"scenarios passed"
+        )
+        return "\n".join(lines)
+
+
+def run_scenario(scenario: Scenario, seed: int = 0) -> ScenarioResult:
+    """Execute one scenario under one seed and audit every invariant.
+    Never raises for an invariant violation — it is reported in the
+    result — but scenario-construction bugs do propagate."""
+    manglers = scenario.manglers() if scenario.manglers else []
+    hash_plane = scenario.hash_plane() if scenario.hash_plane else None
+    rec = BasicRecorder(
+        node_count=scenario.node_count,
+        client_count=scenario.client_count,
+        reqs_per_client=scenario.reqs_per_client,
+        batch_size=scenario.batch_size,
+        seed=seed,
+        manglers=manglers,
+        hash_plane=hash_plane,
+        record=False,
+    )
+
+    pending = sorted(scenario.crashes, key=lambda c: c.at_ms)
+    snapshots: list = []
+    commit_times: list = []
+    last_total = sum(rec._committed_counts.values())
+    result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
+
+    def fire_due_crashes() -> None:
+        while pending and rec.now >= pending[0].at_ms:
+            point = pending.pop(0)
+            state = rec.node_states[point.node]
+            snapshots.append(
+                CrashSnapshot(
+                    node=point.node,
+                    at_ms=rec.now,
+                    committed=list(state.committed_reqs),
+                )
+            )
+            rec.crash(point.node)
+            rec.schedule_restart(point.node, point.restart_delay_ms)
+
+    try:
+        check = True
+        for _ in range(scenario.max_steps):
+            fire_due_crashes()
+            if check or rec._progress:
+                check = False
+                # fully_committed ignores crashed nodes; a scenario only
+                # completes once every scheduled crash has fired AND every
+                # node is back up and caught up.
+                if (
+                    not pending
+                    and rec.fully_committed()
+                    and not any(
+                        rec.node_states[n].crashed
+                        for n in range(rec.node_count)
+                    )
+                ):
+                    break
+            if not rec.step():
+                raise InvariantViolation(
+                    f"event queue drained before convergence "
+                    f"({rec.event_count} events)"
+                )
+            total = sum(rec._committed_counts.values())
+            if total > last_total:
+                last_total = total
+                commit_times.append(rec.now)
+        else:
+            raise InvariantViolation(
+                f"no convergence after {scenario.max_steps} steps "
+                f"({rec.event_count} events, t={rec.now}ms)"
+            )
+
+        check_no_fork(rec)
+        check_durable_prefix(rec, snapshots)
+        check_full_convergence(rec)
+        ends = scenario.disruption_ends()
+        check_bounded_recovery(
+            completion_ms=rec.now,
+            last_disruption_end_ms=max(ends) if ends else 0,
+            bound_ms=scenario.recovery_bound_ms,
+        )
+        result.passed = True
+    except InvariantViolation as violation:
+        result.violation = str(violation)
+
+    result.events = rec.event_count
+    result.sim_ms = rec.now
+    result.commits = last_total
+    for mangler in manglers:
+        if hasattr(mangler, "dropped"):
+            result.counters["partition_drops"] = result.counters.get(
+                "partition_drops", 0
+            ) + mangler.dropped
+    if snapshots:
+        result.counters["crashes"] = len(snapshots)
+    if hash_plane is not None:
+        result.counters["device_errors"] = hash_plane.device_errors
+        result.counters["device_timeouts"] = hash_plane.device_timeouts
+        result.counters["fallback_digests"] = hash_plane.fallback_digests
+        result.counters["breaker"] = hash_plane.breaker.state
+        result.counters["breaker_trips"] = hash_plane.breaker.trips
+    return result
+
+
+def run_campaign(
+    scenarios: list | None = None, seed: int = 0
+) -> CampaignResult:
+    """Run a scenario list (default: the full matrix) under derived
+    per-scenario seeds; reproducible from ``seed`` alone."""
+    if scenarios is None:
+        scenarios = matrix()
+    campaign = CampaignResult(seed=seed)
+    for index, scenario in enumerate(scenarios):
+        campaign.results.append(run_scenario(scenario, seed=seed + index))
+    return campaign

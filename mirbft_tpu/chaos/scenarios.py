@@ -1,0 +1,212 @@
+"""The chaos scenario matrix.
+
+Each ``Scenario`` is declarative: factories (not instances) for manglers
+and crypto planes, because both are stateful per run — the runner builds
+fresh ones for every (scenario, seed) execution so campaigns are
+reproducible and scenarios can repeat across seeds.
+
+The matrix mirrors the reference's fault suite (mirbft_test.go:68-222)
+and extends it with network partitions (with heal) and device-plane
+faults against the coalescing crypto planes."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..resilience import CircuitBreaker
+from ..testengine.crypto_plane import CoalescingHashPlane
+from ..testengine.manglers import (
+    from_source,
+    is_step,
+    msg_type,
+    partition,
+    percent,
+    rule,
+)
+from .faults import FlakyDigestBackend
+
+
+@dataclass(frozen=True)
+class CrashPoint:
+    """Runner-driven crash: at ``at_ms`` simulated time, crash ``node``
+    (snapshotting its durable commit log for the durability invariant)
+    and reboot it from durable state ``restart_delay_ms`` later."""
+
+    at_ms: int
+    node: int
+    restart_delay_ms: int
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str = ""
+    tags: tuple = ()
+    node_count: int = 4
+    client_count: int = 2
+    reqs_per_client: int = 10
+    batch_size: int = 1
+    # Zero-arg factory -> list of manglers (fresh state per run).
+    manglers: object = None
+    crashes: tuple = ()  # CrashPoints, fired by the runner
+    # Zero-arg factory -> hash plane (fresh breaker/counters per run).
+    hash_plane: object = None
+    # Heal instants (ms) of disruptions the manglers inject (partition
+    # until_ms etc.); restarts from ``crashes`` are added automatically.
+    heal_points_ms: tuple = ()
+    recovery_bound_ms: int = 120_000
+    max_steps: int = 600_000
+    notes: dict = field(default_factory=dict)
+
+    def disruption_ends(self) -> list:
+        ends = list(self.heal_points_ms)
+        ends.extend(c.at_ms + c.restart_delay_ms for c in self.crashes)
+        return ends
+
+
+def _flaky_plane(mode: str, **kwargs):
+    """Factory-factory: a CoalescingHashPlane whose backend misbehaves for
+    a call window, guarded by a hair-trigger breaker.
+
+    The lazy plane coalesces a whole run into ~4 backend calls, so the
+    window ``fail_from=1, fail_until=3`` with threshold/probe of 1 walks
+    the breaker through its full lifecycle deterministically: call 0
+    healthy, call 1 fails (trip → open), call 2 is a probe and fails
+    (re-open), call 3 is a probe and succeeds (re-close)."""
+
+    def build():
+        return CoalescingHashPlane(
+            digest_many=FlakyDigestBackend(mode=mode, **kwargs),
+            breaker=CircuitBreaker(failure_threshold=1, probe_interval=1),
+            timeout_s=0.0005 if mode == "slow" else None,
+        )
+
+    return build
+
+
+def matrix() -> list:
+    """The full campaign: baseline, the reference fault suite, partitions
+    with heal, crash schedules, device-plane faults, and combinations."""
+    return [
+        Scenario(
+            name="baseline",
+            description="no faults; anchors event counts for the seed",
+        ),
+        Scenario(
+            name="jitter-30ms",
+            description="30ms delivery jitter on every message",
+            manglers=lambda: [rule(is_step()).jitter(30)],
+        ),
+        Scenario(
+            name="jitter-1000ms",
+            description="1000ms delivery jitter (reorders across ticks)",
+            manglers=lambda: [rule(is_step()).jitter(1000)],
+        ),
+        Scenario(
+            name="duplicate-75pct",
+            description="75% of messages delivered twice (delayed echo)",
+            manglers=lambda: [rule(is_step(), percent(75)).duplicate(300)],
+        ),
+        Scenario(
+            name="drop-10pct",
+            description="10% uniform message loss",
+            manglers=lambda: [rule(is_step(), percent(10)).drop()],
+        ),
+        Scenario(
+            name="ack-loss-70pct",
+            description="70% RequestAck loss from nodes 1 and 2",
+            manglers=lambda: [
+                rule(msg_type("RequestAck"), from_source(1, 2), percent(70))
+                .drop()
+            ],
+        ),
+        Scenario(
+            name="partition-minority",
+            description="node 0 isolated 2s..12s, then heals",
+            manglers=lambda: [
+                partition([[0], [1, 2, 3]], from_ms=2000, until_ms=12_000)
+            ],
+            heal_points_ms=(12_000,),
+        ),
+        Scenario(
+            name="partition-split-2-2",
+            description="2-2 split (no quorum anywhere) 2s..10s, then heals",
+            manglers=lambda: [
+                partition([[0, 1], [2, 3]], from_ms=2000, until_ms=10_000)
+            ],
+            heal_points_ms=(10_000,),
+        ),
+        Scenario(
+            name="partition-flapping",
+            description="node 3 isolated twice: 2s..6s and 9s..13s",
+            manglers=lambda: [
+                partition([[3], [0, 1, 2]], from_ms=2000, until_ms=6000),
+                partition([[3], [0, 1, 2]], from_ms=9000, until_ms=13_000),
+            ],
+            heal_points_ms=(6000, 13_000),
+        ),
+        Scenario(
+            name="crash-restart",
+            description="node 1 crashes at 3s, reboots from WAL 5s later",
+            crashes=(CrashPoint(at_ms=3000, node=1, restart_delay_ms=5000),),
+        ),
+        Scenario(
+            name="crash-staggered-pair",
+            description="nodes 1 and 2 crash/restart at staggered times "
+            "(never below quorum simultaneously)",
+            crashes=(
+                CrashPoint(at_ms=3000, node=1, restart_delay_ms=5000),
+                CrashPoint(at_ms=12_000, node=2, restart_delay_ms=5000),
+            ),
+        ),
+        Scenario(
+            name="device-digest-dies",
+            description="digest device raises mid-run; breaker trips to "
+            "host oracle, then a probe re-closes it",
+            hash_plane=_flaky_plane("die", fail_from=1, fail_until=3),
+            tags=("device",),
+        ),
+        Scenario(
+            name="device-digest-short-read",
+            description="digest device returns half a batch (lying "
+            "readback); plane recomputes on host",
+            hash_plane=_flaky_plane("short", fail_from=1, fail_until=3),
+            tags=("device",),
+        ),
+        Scenario(
+            name="device-digest-hangs",
+            description="digest device exceeds its deadline for a window; "
+            "timeouts trip the breaker",
+            hash_plane=_flaky_plane("slow", fail_from=1, fail_until=3),
+            tags=("device",),
+        ),
+        Scenario(
+            name="partition-plus-crash",
+            description="node 0 isolated 2s..10s while node 2 crashes at "
+            "4s and reboots at 9s",
+            manglers=lambda: [
+                partition([[0], [1, 2, 3]], from_ms=2000, until_ms=10_000)
+            ],
+            crashes=(CrashPoint(at_ms=4000, node=2, restart_delay_ms=5000),),
+            heal_points_ms=(10_000,),
+        ),
+        Scenario(
+            name="partition-plus-duplication",
+            description="2-2 split 2s..8s under 50% duplication",
+            manglers=lambda: [
+                partition([[0, 1], [2, 3]], from_ms=2000, until_ms=8000),
+                rule(is_step(), percent(50)).duplicate(300),
+            ],
+            heal_points_ms=(8000,),
+        ),
+    ]
+
+
+# The tier-1 smoke subset: one partition-with-heal, one crash-with-
+# restart, one device-plane failure — the three disruption families.
+SMOKE_NAMES = ("partition-minority", "crash-restart", "device-digest-dies")
+
+
+def smoke_matrix() -> list:
+    by_name = {s.name: s for s in matrix()}
+    return [by_name[name] for name in SMOKE_NAMES]

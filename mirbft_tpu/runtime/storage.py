@@ -27,6 +27,23 @@ _REC_HEADER = struct.Struct("<IQI")  # payload_len, index, crc32(payload)
 _SEGMENT_TARGET = 4 * 1024 * 1024
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory: os.replace/creat/unlink order *data*, but the
+    directory entry itself is not durable until the directory inode is
+    synced — without this, a crash after compaction/truncation can come
+    back up with the pre-rename file (or both, or neither)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse dir fsync; best effort
+    finally:
+        os.close(fd)
+
+
 class CorruptWal(Exception):
     pass
 
@@ -96,8 +113,11 @@ class FileWal:
 
     def _open_active(self, first_index: int):
         path = os.path.join(self.seg_dir, f"{first_index}.wal")
+        created = not os.path.exists(path)
         self._active = open(path, "ab")
         self._active_size = self._active.tell()
+        if created:
+            _fsync_dir(self.seg_dir)
 
     def write(self, index: int, entry: pb.Persistent) -> None:
         with self._lock:
@@ -133,15 +153,20 @@ class FileWal:
             f.flush()
             os.fsync(f.fileno())
         os.replace(self._head_path + ".tmp", self._head_path)
+        _fsync_dir(self.path)
         self._entries = [(i, e) for i, e in self._entries if i >= index]
         # Remove whole segments that ended below the head.
         segments = self._segments()
+        unlinked = False
         for seg_first, seg_next in zip(segments, segments[1:]):
             if seg_next <= index:
                 seg_path = os.path.join(self.seg_dir, f"{seg_first}.wal")
                 if self._active is not None and self._active.name == seg_path:
                     continue
                 os.unlink(seg_path)
+                unlinked = True
+        if unlinked:
+            _fsync_dir(self.seg_dir)
 
     def sync(self) -> None:
         with self._lock:
@@ -223,6 +248,7 @@ class FileRequestStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._log_path)
+        _fsync_dir(self.path)
 
     @staticmethod
     def _write_record(f, op: int, ack: pb.RequestAck, data: bytes) -> None:

@@ -5,8 +5,24 @@ The reference deliberately ships no transport — the entire contract is
 (reference: processor.go:23-25); the protocol tolerates loss via
 retransmit ticks.  This module is the consumer-side implementation for
 multi-host deployments: length-prefixed frames of the deterministic wire
-codec over persistent TCP connections between replica hosts, with the
-same drop-on-failure semantics the protocol already assumes.
+codec over persistent TCP connections between replica hosts.
+
+Fault model (the hardening layer over the bare Link contract):
+
+- ``send`` never blocks the caller: frames enqueue onto a bounded
+  per-peer outbound queue drained by a dedicated sender thread, so one
+  stalled peer cannot block broadcast to the others.
+- The sender thread (re)connects lazily and retries failed connections
+  with exponential backoff + full jitter (resilience.Backoff), so a
+  restarted peer is re-dialed automatically and a recovering peer is not
+  met with a connection storm from the whole mesh.
+- Queue overflow drops the *oldest* frame (newest protocol messages
+  supersede older ones); every drop, failure, and reconnect is counted
+  and surfaced via ``counters()`` / ``status.transport_status`` so chaos
+  runs can assert on observed fault counts.
+- ``close(drain_timeout=...)`` optionally flushes queued frames over
+  live connections before tearing down, and shuts the write side down
+  first so peers observe a clean EOF rather than a reset.
 
 Authentication note: the reference makes source authentication the
 caller's job (mirbft.go:297-301).  Frames carry a claimed source id; a
@@ -19,29 +35,180 @@ Frame format: [u32 little-endian total length][varint source][pb.Msg].
 
 from __future__ import annotations
 
+import collections
 import socket
 import struct
 import threading
+import time
 
 from .. import pb, wire
+from ..resilience import Backoff
 from .processor import Link
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
 
 
+class _PeerChannel:
+    """Outbound lane to one peer: a bounded frame queue plus the sender
+    thread that owns connecting, retrying, and draining it."""
+
+    def __init__(self, transport: "TcpTransport", peer_id: int):
+        self.transport = transport
+        self.peer_id = peer_id
+        self.queue: collections.deque[bytes] = collections.deque()
+        self.cv = threading.Condition()
+        self.closed = False
+        self._drain_deadline = 0.0
+        self.backoff = Backoff(
+            base=transport.backoff_base, cap=transport.backoff_cap
+        )
+        # Drop/retry accounting (read via TcpTransport.counters()).
+        self.enqueued = 0
+        self.sent = 0
+        self.dropped_overflow = 0
+        self.dropped_closed = 0
+        self.send_failures = 0
+        self.connect_failures = 0
+        self.connects = 0
+        self.thread = threading.Thread(
+            target=self._run,
+            name=f"tcp-send-{transport.node_id}-{peer_id}",
+            daemon=True,
+        )
+        self.thread.start()
+
+    def enqueue(self, frame: bytes) -> None:
+        with self.cv:
+            if self.closed:
+                self.dropped_closed += 1
+                return
+            if len(self.queue) >= self.transport.queue_depth:
+                self.queue.popleft()
+                self.dropped_overflow += 1
+            self.queue.append(frame)
+            self.enqueued += 1
+            self.cv.notify()
+
+    def close(self, drain_timeout: float) -> None:
+        with self.cv:
+            self.closed = True
+            self._drain_deadline = time.monotonic() + drain_timeout
+            self.cv.notify()
+
+    # -- sender thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self.cv:
+                while not self.queue and not self.closed:
+                    self.cv.wait()
+                if self.closed and (
+                    not self.queue
+                    or time.monotonic() >= self._drain_deadline
+                ):
+                    self.dropped_closed += len(self.queue)
+                    self.queue.clear()
+                    return
+                frame = self.queue.popleft()
+            entry = self._ensure_connected()
+            if entry is None:
+                # Shut down while connecting/backing off: the frame (and
+                # the rest of the queue, handled above) is dropped.
+                with self.cv:
+                    self.dropped_closed += 1
+                continue
+            conn, send_lock = entry
+            try:
+                with send_lock:
+                    conn.sendall(frame)
+            except OSError:
+                self.send_failures += 1
+                self._drop_conn(entry)
+                # Put the frame back at the head so delivery resumes in
+                # order after reconnect — unless that would overflow.
+                with self.cv:
+                    if len(self.queue) < self.transport.queue_depth:
+                        self.queue.appendleft(frame)
+                    else:
+                        self.dropped_overflow += 1
+                continue
+            with self.cv:
+                self.sent += 1
+
+    def _ensure_connected(self):
+        """Return the live (socket, lock) entry for this peer, dialing with
+        backoff until connected or the channel/transport closes."""
+        transport = self.transport
+        while True:
+            with transport._lock:
+                entry = transport._conns.get(self.peer_id)
+                address = transport._peers.get(self.peer_id)
+            if entry is not None:
+                return entry
+            closing = transport._closed.is_set() or self.closed
+            if closing or address is None:
+                # No new connections once closing; draining only flushes
+                # over connections that already exist.
+                return None
+            try:
+                conn = socket.create_connection(address, timeout=5)
+            except OSError:
+                self.connect_failures += 1
+                delay = self.backoff.next()
+                with self.cv:
+                    if not self.closed:
+                        self.cv.wait(timeout=delay)
+                continue
+            self.backoff.reset()
+            entry = (conn, threading.Lock())
+            with transport._lock:
+                if transport._closed.is_set():
+                    conn.close()
+                    return None
+                existing = transport._conns.setdefault(self.peer_id, entry)
+            if existing is not entry:
+                conn.close()
+                entry = existing
+            else:
+                self.connects += 1
+            return entry
+
+    def _drop_conn(self, entry) -> None:
+        transport = self.transport
+        with transport._lock:
+            if transport._conns.get(self.peer_id) is entry:
+                del transport._conns[self.peer_id]
+        entry[0].close()
+
+
 class TcpTransport:
     """One replica's endpoint: a listening socket delivering inbound
-    messages to the local Node, and lazily-connected outbound links."""
+    messages to the local Node, and queue-backed outbound links with
+    automatic reconnection."""
 
-    def __init__(self, node_id: int, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        node_id: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        queue_depth: int = 1024,
+        backoff_base: float = 0.05,
+        backoff_cap: float = 2.0,
+    ):
         self.node_id = node_id
+        self.queue_depth = queue_depth
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
         self._node = None
         self._peers: dict[int, tuple] = {}  # id -> (host, port)
         # id -> (socket, per-connection send lock).  The transport-wide
-        # _lock guards only the maps; sends serialize per peer so one
-        # stalled peer cannot block broadcast to the others.
+        # _lock guards only the maps; each peer's sends run on its own
+        # sender thread so one stalled peer cannot block the others.
         self._conns: dict[int, tuple[socket.socket, threading.Lock]] = {}
+        self._channels: dict[int, _PeerChannel] = {}
+        # Sends to peers never registered via connect(): dropped, counted.
+        self.dropped_unknown = 0
         # Accepted inbound sockets.  close() must shutdown+close these too:
         # leaving them open keeps their read threads blocked in recv, keeps
         # the port occupied past a rebind, and — worse — lets a "closed"
@@ -67,7 +234,7 @@ class TcpTransport:
 
     def connect(self, peer_id: int, address: tuple) -> None:
         """Register a peer's address; connections are opened lazily on the
-        first send and re-opened after failures."""
+        first send and re-dialed with backoff after failures."""
         with self._lock:
             self._peers[peer_id] = tuple(address)
 
@@ -82,40 +249,47 @@ class TcpTransport:
 
         return _TcpLink()
 
+    def _channel(self, dest: int) -> _PeerChannel | None:
+        with self._lock:
+            channel = self._channels.get(dest)
+            if channel is not None:
+                return channel
+            if dest not in self._peers or self._closed.is_set():
+                return None
+            channel = _PeerChannel(self, dest)
+            self._channels[dest] = channel
+            return channel
+
     def _send(self, dest: int, msg: pb.Msg) -> None:
         payload = wire.encode_varint(self.node_id) + pb.encode(msg)
         frame = _LEN.pack(len(payload)) + payload
+        channel = self._channel(dest)
+        if channel is None:
+            self.dropped_unknown += 1
+            return  # unknown peer: dropped, like any unreachable host
+        channel.enqueue(frame)
+
+    def counters(self) -> dict:
+        """Per-peer drop/retry accounting for dashboards and chaos gates
+        (see status.transport_status for the dataclass view)."""
         with self._lock:
-            entry = self._conns.get(dest)
-            address = self._peers.get(dest)
-        if entry is None:
-            if address is None or self._closed.is_set():
-                return  # unknown peer: dropped, like any unreachable host
-            try:
-                conn = socket.create_connection(address, timeout=5)
-            except OSError:
-                return  # peer down: dropped; retransmit ticks recover
-            entry = (conn, threading.Lock())
-            with self._lock:
-                # Re-check under the lock: close() may have swept _conns
-                # while create_connection blocked; inserting now would leak
-                # the socket past shutdown.
-                if self._closed.is_set():
-                    conn.close()
-                    return
-                existing = self._conns.setdefault(dest, entry)
-            if existing is not entry:
-                conn.close()
-                entry = existing
-        conn, send_lock = entry
-        try:
-            with send_lock:
-                conn.sendall(frame)
-        except OSError:
-            with self._lock:
-                if self._conns.get(dest) is entry:
-                    del self._conns[dest]
-            conn.close()
+            channels = dict(self._channels)
+            connected = set(self._conns)
+        peers = {}
+        for peer_id, ch in channels.items():
+            with ch.cv:
+                peers[peer_id] = {
+                    "connected": peer_id in connected,
+                    "queue_depth": len(ch.queue),
+                    "enqueued": ch.enqueued,
+                    "sent": ch.sent,
+                    "dropped_overflow": ch.dropped_overflow,
+                    "dropped_closed": ch.dropped_closed,
+                    "send_failures": ch.send_failures,
+                    "connect_failures": ch.connect_failures,
+                    "connects": ch.connects,
+                }
+        return {"dropped_unknown": self.dropped_unknown, "peers": peers}
 
     # -- inbound ---------------------------------------------------------------
 
@@ -188,15 +362,39 @@ class TcpTransport:
 
     # -- shutdown --------------------------------------------------------------
 
-    def close(self) -> None:
+    def close(self, drain_timeout: float = 0.0) -> None:
+        """Tear down the transport.  With ``drain_timeout > 0`` the sender
+        threads first flush queued frames over connections that are already
+        established (no new dials once closing)."""
         self._closed.set()
+        # shutdown() wakes the accept thread's blocked accept() NOW.  With
+        # close() alone the blocked syscall pins the file description, so
+        # the kernel keeps the socket in LISTEN: a "closed" transport kept
+        # completing handshakes (and peers' reconnects black-holed into
+        # immediately-discarded connections) until the next accept wake.
+        try:
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         self._server.close()
+        with self._lock:
+            channels = list(self._channels.values())
+        for channel in channels:
+            channel.close(drain_timeout)
+        for channel in channels:
+            channel.thread.join(timeout=max(drain_timeout, 0) + 5)
         with self._lock:
             conns = [conn for conn, _lock in self._conns.values()]
             self._conns.clear()
             accepted = list(self._accepted)
             self._accepted.clear()
         for conn in conns:
+            # Half-close first: the peer's reader sees a clean EOF for any
+            # frames already in flight instead of a reset.
+            try:
+                conn.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
             conn.close()
         for conn in accepted:
             # shutdown unblocks the read thread's recv immediately; close

@@ -203,6 +203,132 @@ def state_machine_status(machine) -> StateMachineStatus:
     )
 
 
+@dataclass
+class PeerLinkStatus:
+    """One peer's outbound channel (runtime/transport.py counters)."""
+
+    peer_id: int
+    enqueued: int
+    sent: int
+    dropped_overflow: int
+    dropped_closed: int
+    send_failures: int
+    connect_failures: int
+    connects: int
+    queue_depth: int
+
+
+@dataclass
+class TransportStatus:
+    """Snapshot of a TcpTransport's drop/retry accounting."""
+
+    node_id: int
+    dropped_unknown: int
+    peers: list = field(default_factory=list)  # [PeerLinkStatus]
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, default=str)
+
+    def pretty(self) -> str:
+        lines = [f"=== Transport (node {self.node_id}) ==="]
+        if self.dropped_unknown:
+            lines.append(f"  dropped (unknown peer): {self.dropped_unknown}")
+        for peer in self.peers:
+            drops = peer.dropped_overflow + peer.dropped_closed
+            lines.append(
+                f"  peer {peer.peer_id}: sent={peer.sent}/{peer.enqueued} "
+                f"queued={peer.queue_depth} dropped={drops} "
+                f"send_failures={peer.send_failures} "
+                f"connects={peer.connects} "
+                f"(failed {peer.connect_failures})"
+            )
+        return "\n".join(lines)
+
+
+def transport_status(transport) -> TransportStatus:
+    """Snapshot a runtime.transport.TcpTransport."""
+    counters = transport.counters()
+    return TransportStatus(
+        node_id=transport.node_id,
+        dropped_unknown=counters["dropped_unknown"],
+        peers=[
+            PeerLinkStatus(peer_id=peer_id, **stats)
+            for peer_id, stats in sorted(counters["peers"].items())
+        ],
+    )
+
+
+@dataclass
+class BreakerStatus:
+    state: str
+    consecutive_failures: int
+    failures: int
+    successes: int
+    trips: int
+    probes: int
+
+
+@dataclass
+class CryptoPlaneStatus:
+    """Device-health snapshot of a digest or signature plane: how much
+    work the device did vs. was rescued/fallen back to the host, and what
+    the circuit breaker thinks of the device right now."""
+
+    plane: str
+    flushes: int
+    device_errors: int
+    fallback_work: int
+    device_timeouts: int = 0
+    rescued_digests: int = 0
+    breaker: BreakerStatus | None = None
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, default=str)
+
+    def pretty(self) -> str:
+        lines = [f"=== Crypto plane ({self.plane}) ==="]
+        lines.append(
+            f"  flushes={self.flushes} device_errors={self.device_errors} "
+            f"timeouts={self.device_timeouts} "
+            f"fallback={self.fallback_work} rescued={self.rescued_digests}"
+        )
+        if self.breaker is not None:
+            b = self.breaker
+            lines.append(
+                f"  breaker: {b.state} "
+                f"(consecutive_failures={b.consecutive_failures}, "
+                f"trips={b.trips}, probes={b.probes}, "
+                f"{b.successes} ok / {b.failures} failed)"
+            )
+        return "\n".join(lines)
+
+
+def crypto_plane_status(plane) -> CryptoPlaneStatus:
+    """Snapshot a testengine crypto plane (CoalescingHashPlane,
+    AsyncKernelHashPlane, SignaturePlane, or AsyncSignaturePlane)."""
+    breaker = getattr(plane, "breaker", None)
+    breaker_status = None
+    if breaker is not None:
+        breaker_status = BreakerStatus(
+            state=breaker.state,
+            consecutive_failures=breaker.consecutive_failures,
+            failures=breaker.failures,
+            successes=breaker.successes,
+            trips=breaker.trips,
+            probes=breaker.probes,
+        )
+    return CryptoPlaneStatus(
+        plane=type(plane).__name__,
+        flushes=len(plane.flush_sizes),
+        device_errors=getattr(plane, "device_errors", 0),
+        device_timeouts=getattr(plane, "device_timeouts", 0),
+        fallback_work=getattr(plane, "fallback_digests", 0)
+        or getattr(plane, "fallback_verifies", 0),
+        rescued_digests=getattr(plane, "rescued_digests", 0),
+        breaker=breaker_status,
+    )
+
+
 def pretty(status: StateMachineStatus) -> str:
     """ASCII dashboard (reference: status/status.go:141-296)."""
     lines = [

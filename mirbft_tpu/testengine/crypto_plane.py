@@ -25,6 +25,17 @@ from __future__ import annotations
 import time
 
 from .. import pb
+from ..resilience import CircuitBreaker
+
+
+class DevicePlaneError(Exception):
+    """A device digest/verify call failed or returned a short read."""
+
+
+def _host_digest_many(msgs: list) -> list:
+    import hashlib
+
+    return [hashlib.sha256(m).digest() for m in msgs]
 
 
 class _Lazy:
@@ -45,20 +56,59 @@ class CoalescingHashPlane:
     host hashlib (useful to isolate the coalescing itself in tests).
     """
 
-    def __init__(self, digest_many=None):
+    def __init__(self, digest_many=None, breaker=None, timeout_s=None):
         if digest_many is None:
-            import hashlib
-
-            def digest_many(msgs):
-                return [hashlib.sha256(m).digest() for m in msgs]
-
+            digest_many = _host_digest_many
         self.digest_many = digest_many
+        # Degradation policy: a device batch that raises, returns a short
+        # read, or (with timeout_s set) exceeds the deadline counts as a
+        # failure; the batch is recomputed on the host oracle and the
+        # breaker decides when to stop trying the device altogether (and
+        # when to probe it for recovery).  Values are identical either
+        # way, so determinism and recorded logs are unaffected.
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.timeout_s = timeout_s
         self._pending: list[bytes] = []  # concatenated preimages
         self._base = 0  # global index of _pending[0]
         self._results: dict[int, bytes] = {}
         # Telemetry for the bench: one entry per flush.
         self.flush_sizes: list[int] = []
         self.flush_wall_s: list[float] = []
+        # Fault accounting (surfaced via status.crypto_plane_status).
+        self.device_errors = 0
+        self.device_timeouts = 0
+        self.fallback_digests = 0
+
+    def _guarded_digest_many(self, msgs: list) -> list:
+        """Run the digest backend under the circuit breaker; any failure
+        falls back to the host oracle so consensus never stalls on a
+        lost or lying device."""
+        if not self.breaker.allow():
+            self.fallback_digests += len(msgs)
+            return _host_digest_many(msgs)
+        start = time.perf_counter()
+        try:
+            digests = self.digest_many(msgs)
+            if len(digests) != len(msgs):
+                raise DevicePlaneError(
+                    f"short read: {len(digests)} of {len(msgs)} digests"
+                )
+        except Exception:
+            self.breaker.record_failure()
+            self.device_errors += 1
+            self.fallback_digests += len(msgs)
+            return _host_digest_many(msgs)
+        if (
+            self.timeout_s is not None
+            and time.perf_counter() - start > self.timeout_s
+        ):
+            # The values are good but the device is too slow to trust on
+            # the hot path: count it toward tripping the breaker.
+            self.breaker.record_failure()
+            self.device_timeouts += 1
+        else:
+            self.breaker.record_success()
+        return digests
 
     # -- executor side (called from Recorder._execute) -----------------------
 
@@ -96,7 +146,7 @@ class CoalescingHashPlane:
         if not self._pending:
             return
         start = time.perf_counter()
-        digests = self.digest_many(self._pending)
+        digests = self._guarded_digest_many(self._pending)
         self.flush_wall_s.append(time.perf_counter() - start)
         self.flush_sizes.append(len(self._pending))
         for offset, digest in enumerate(digests):
@@ -133,8 +183,10 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         chunk_bytes: int = 1 << 21,
         kernel_fn=None,
         min_device_rows: int = 4096,
+        breaker=None,
+        timeout_s=None,
     ):
-        super().__init__(digest_many=None)
+        super().__init__(digest_many=None, breaker=breaker, timeout_s=timeout_s)
         self.max_chunk_rows = chunk_rows
         self.chunk_bytes = chunk_bytes
         # Digest kernel: fn(blocks, n_blocks) -> (batch, 8) uint32 words.
@@ -280,6 +332,23 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         self.host_digests += len(group)
 
     def _launch(self, bucket: int, group: list) -> None:
+        if not self.breaker.allow():
+            # Device circuit open: the group degrades to the host oracle
+            # (throughput loss, never a stall) until a probe closes it.
+            self.fallback_digests += len(group)
+            self._host_hash(group)
+            return
+        try:
+            self._launch_device(bucket, group)
+        except Exception:
+            # Kernel dispatch / device-put blew up (device lost, OOM,
+            # compile failure): rescue the whole group on the host.
+            self.breaker.record_failure()
+            self.device_errors += 1
+            self.fallback_digests += len(group)
+            self._host_hash(group)
+
+    def _launch_device(self, bucket: int, group: list) -> None:
         import jax
 
         from ..ops.batching import pack_preimages
@@ -367,7 +436,29 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
             return results[index]
         import numpy as np
 
-        raw = np.asarray(words).astype(">u4").tobytes()
+        try:
+            raw = np.asarray(words).astype(">u4").tobytes()
+            if len(raw) < 32 * len(group):
+                raise DevicePlaneError(
+                    f"short readback: {len(raw)} bytes for {len(group)} rows"
+                )
+        except Exception:
+            # The device died (or lied) between launch and readback: the
+            # preimages ride along with the chunk, so rescue on the host
+            # and charge the breaker.
+            import hashlib
+
+            self.breaker.record_failure()
+            self.device_errors += 1
+            for i, msg in group:
+                results[i] = hashlib.sha256(msg).digest()
+                del self._chunk_of[i]
+            self.rescued_digests += len(group)
+            self.device_digests -= len(group)
+            self.fallback_digests += len(group)
+            self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+            return results[index]
+        self.breaker.record_success()
         self.flush_wall_s.append(launch_s + time.perf_counter() - start)
         for row, (i, _msg) in enumerate(group):
             results[i] = raw[32 * row : 32 * row + 32]

@@ -874,6 +874,14 @@ class Recorder:
             self._submit_next_request(client)
 
     def _apply_batch(self, node: int, state: NodeState, batch: pb.QEntry) -> None:
+        if batch.seq_no <= state.last_committed:
+            # A restarted state machine replays from its last stable
+            # checkpoint and re-emits commits the durable app already
+            # applied before the crash (reference contract: the app owns
+            # commit idempotency, processor.go's persisted last-applied).
+            # Re-applying would double-hash the app chain and fork the
+            # node's next checkpoint off the network.
+            return
         state.last_committed = batch.seq_no
         for ack in batch.requests:
             triggered = self.reconfig_on_commit.get((ack.client_id, ack.req_no))

@@ -247,3 +247,53 @@ class _Rule:
 
 def rule(*predicates) -> _Rule:
     return _Rule(predicates)
+
+
+# ---------------------------------------------------------------------------
+# Network partitions
+# ---------------------------------------------------------------------------
+
+
+def crosses_partition(groups):
+    """Matches EventStep messages whose source and destination lie in
+    *different* groups.  ``groups`` is an iterable of node-id collections;
+    a node appearing in no group is unaffected (its traffic always
+    passes), so ``[[0], [1, 2, 3]]`` isolates node 0 from the rest."""
+    group_of: dict[int, int] = {}
+    for gi, members in enumerate(groups):
+        for member in members:
+            group_of[member] = gi
+
+    def pred(_recorder, _when, node, event):
+        inner = event.type
+        if not isinstance(inner, pb.EventStep):
+            return False
+        src = group_of.get(inner.source)
+        dst = group_of.get(node)
+        return src is not None and dst is not None and src != dst
+
+    return pred
+
+
+def partition(groups, from_ms: int = 0, until_ms: int | None = None):
+    """Network partition with heal: every inter-group EventStep during
+    [from_ms, until_ms) is dropped; traffic before the split and after the
+    heal flows normally.  ``until_ms=None`` never heals.  Messages lost to
+    the partition are gone for good — post-heal progress relies on the
+    protocol's retransmission ticks, which is exactly the liveness property
+    the chaos invariants assert.  The returned mangler counts casualties on
+    its ``dropped`` attribute."""
+    cross = crosses_partition(groups)
+
+    def mangler(recorder, when, node, event):
+        if (
+            when >= from_ms
+            and (until_ms is None or when < until_ms)
+            and cross(recorder, when, node, event)
+        ):
+            mangler.dropped += 1
+            return None
+        return (when, node, event)
+
+    mangler.dropped = 0
+    return mangler

@@ -19,6 +19,8 @@ different (client, req_no) fails verification.
 from __future__ import annotations
 
 from ..crypto import ed25519_host as host
+from ..resilience import CircuitBreaker
+from .crypto_plane import DevicePlaneError
 
 SIG_LEN = 64
 PK_LEN = 32
@@ -162,14 +164,48 @@ class SignaturePlane:
     replicas receive it.  Deterministic: verdicts depend only on the data.
     """
 
-    def __init__(self, verifier=host_verifier):
+    def __init__(self, verifier=host_verifier, breaker=None, timeout_s=None):
         self.verifier = verifier
+        # Same degradation policy as the digest plane: a verifier batch
+        # that raises, short-reads, or times out recomputes on the host
+        # oracle, and the breaker decides when to stop trying the device.
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.timeout_s = timeout_s
+        self.device_errors = 0
+        self.fallback_verifies = 0
         self._pending: list = []  # [(client_id, req_no, data)]
         self._verdicts: dict = {}
         self.flush_sizes: list[int] = []
         # Blocking wall time per flush — the ingress-auth latency the
         # replica actually experiences (the bench's rung-3 verify p99).
         self.flush_wall_s: list[float] = []
+
+    def _guarded_verify(self, batch: list) -> list:
+        if not self.breaker.allow():
+            self.fallback_verifies += len(batch)
+            return host_verifier(batch)
+        import time
+
+        start = time.perf_counter()
+        try:
+            verdicts = self.verifier(batch)
+            if len(verdicts) != len(batch):
+                raise DevicePlaneError(
+                    f"short read: {len(verdicts)} of {len(batch)} verdicts"
+                )
+        except Exception:
+            self.breaker.record_failure()
+            self.device_errors += 1
+            self.fallback_verifies += len(batch)
+            return host_verifier(batch)
+        if (
+            self.timeout_s is not None
+            and time.perf_counter() - start > self.timeout_s
+        ):
+            self.breaker.record_failure()
+        else:
+            self.breaker.record_success()
+        return verdicts
 
     def _key(self, client_id: int, req_no: int, data: bytes):
         return (client_id, req_no, data)
@@ -202,7 +238,7 @@ class SignaturePlane:
         self._pending = []
         self.flush_sizes.append(len(batch))
         start = time.perf_counter()
-        verdicts = self.verifier(batch)
+        verdicts = self._guarded_verify(batch)
         self.flush_wall_s.append(time.perf_counter() - start)
         for item, verdict in zip(batch, verdicts, strict=True):
             self._verdicts[self._key(*item)] = verdict
@@ -239,6 +275,8 @@ class AsyncSignaturePlane(SignaturePlane):
         sublanes: int = 8,
         min_device_rows: int = 16,
         launch_fn=None,
+        breaker=None,
+        timeout_s=None,
     ):
         # Default chunk/sublanes: 1024-row launches on the 8x128 tile.
         # A monolithic wave would make the FIRST forced readback wait for
@@ -258,6 +296,10 @@ class AsyncSignaturePlane(SignaturePlane):
         self._verdicts = {}
         self.flush_sizes = []
         self.flush_wall_s = []
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.timeout_s = timeout_s
+        self.device_errors = 0
+        self.fallback_verifies = 0
         self.chunk = chunk
         self.sublanes = sublanes
         self.min_device_rows = min_device_rows
@@ -266,7 +308,10 @@ class AsyncSignaturePlane(SignaturePlane):
         # Pallas default needs a real TPU).
         self._launch_fn = launch_fn
         self._wave: list = []  # [(key, marshal_light row, pk, msg, sig)]
-        self._chunks: dict = {}  # cid -> (keys, out, launch_s)
+        # cid -> (wave entries, out, launch_s); the full entries (not just
+        # keys) are retained so a failed readback can host-rescue from the
+        # (pk, msg, sig) material without re-marshalling.
+        self._chunks: dict = {}
         self._chunk_of: dict = {}  # key -> cid
         self._next_chunk = 0
         self._dirty = False
@@ -315,20 +360,31 @@ class AsyncSignaturePlane(SignaturePlane):
 
             self._launch_fn = launch_rows
         wave, self._wave = self._wave, []
+        if not self.breaker.allow():
+            self._host_verify_wave(wave)
+            self.fallback_verifies += len(wave)
+            return
         start = time.perf_counter()
-        out = self._launch_fn(
-            [row for _k, row, _pk, _m, _s in wave], sublanes=self.sublanes
-        )
+        try:
+            out = self._launch_fn(
+                [row for _k, row, _pk, _m, _s in wave],
+                sublanes=self.sublanes,
+            )
+        except Exception:
+            self.breaker.record_failure()
+            self.device_errors += 1
+            self.fallback_verifies += len(wave)
+            self._host_verify_wave(wave)
+            return
         launch_s = time.perf_counter() - start
-        keys = [k for k, _row, _pk, _m, _s in wave]
         cid = self._next_chunk
         self._next_chunk += 1
-        self._chunks[cid] = (keys, out, launch_s)
-        for k in keys:
+        self._chunks[cid] = (wave, out, launch_s)
+        for k, _row, _pk, _m, _s in wave:
             self._chunk_of[k] = cid
-        self.flush_sizes.append(len(keys))
+        self.flush_sizes.append(len(wave))
         self.overlapped_launches += 1
-        self.device_verifies += len(keys)
+        self.device_verifies += len(wave)
 
     def valid(self, client_id: int, req_no: int, data: bytes) -> bool:
         key = self._key(client_id, req_no, data)
@@ -348,27 +404,50 @@ class AsyncSignaturePlane(SignaturePlane):
 
         import numpy as np
 
-        keys, out, launch_s = self._chunks.pop(cid)
+        wave, out, launch_s = self._chunks.pop(cid)
         start = time.perf_counter()
-        valid = np.asarray(out)
+        try:
+            valid = np.asarray(out)
+            if len(valid) < len(wave):
+                raise DevicePlaneError(
+                    f"short readback: {len(valid)} of {len(wave)} verdicts"
+                )
+        except Exception:
+            # Device died mid-wave: rescue from the retained (pk, msg, sig)
+            # material via the host oracle, and let the breaker steer the
+            # next waves straight to _host_verify_wave.
+            self.breaker.record_failure()
+            self.device_errors += 1
+            self.fallback_verifies += len(wave)
+            self.device_verifies -= len(wave)
+            for k, _row, _pk, _m, _s in wave:
+                del self._chunk_of[k]
+            self._host_verify_wave(wave)
+            self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+            return self._verdicts[key]
+        self.breaker.record_success()
         self.flush_wall_s.append(launch_s + time.perf_counter() - start)
         verdicts = self._verdicts
         chunk_of = self._chunk_of
-        for i, k in enumerate(keys):
+        for i, (k, _row, _pk, _m, _s) in enumerate(wave):
             verdicts[k] = bool(valid[i])
             del chunk_of[k]
         return verdicts[key]
 
-    def _flush(self) -> None:
-        """Host-verify the pending (sub-tile) wave synchronously."""
-        if not self._wave:
-            return
+    def _host_verify_wave(self, wave: list) -> None:
+        """Synchronously judge a wave's entries via the host oracle."""
         import time
 
-        wave, self._wave = self._wave, []
         self.flush_sizes.append(len(wave))
         start = time.perf_counter()
         for key, _row, pk, msg, sig in wave:
             self._verdicts[key] = host.verify(pk, msg, sig)
         self.flush_wall_s.append(time.perf_counter() - start)
         self.host_verifies += len(wave)
+
+    def _flush(self) -> None:
+        """Host-verify the pending (sub-tile) wave synchronously."""
+        if not self._wave:
+            return
+        wave, self._wave = self._wave, []
+        self._host_verify_wave(wave)

@@ -29,6 +29,11 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running scale tests")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection campaign tests (the smoke subset runs in "
+        "tier-1; the full matrix is also marked slow)",
+    )
     # The axon TPU plugin IGNORES JAX_PLATFORMS=cpu (the default backend
     # stays "tpu" and default-placed arrays go through the tunnel, whose
     # latency weather makes kernel-path stress tests flaky).  Pin the

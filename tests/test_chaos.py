@@ -1,0 +1,195 @@
+"""Chaos campaign harness (mirbft_tpu/chaos/): the seeded scenario matrix,
+the invariant checker, and the partition mangler.
+
+The three-smoke subset (partition + heal, crash + restart, device-plane
+failure) runs in tier-1; the full matrix rides the slow lane alongside
+``python -m mirbft_tpu.chaos``."""
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.chaos import (
+    CrashSnapshot,
+    InvariantViolation,
+    check_durable_prefix,
+    check_no_fork,
+    matrix,
+    run_campaign,
+    run_scenario,
+    smoke_matrix,
+    SMOKE_NAMES,
+)
+from mirbft_tpu.testengine import BasicRecorder
+from mirbft_tpu.testengine.manglers import partition
+
+BY_NAME = {s.name: s for s in matrix()}
+
+
+# ---------------------------------------------------------------------------
+# Partition mangler semantics
+# ---------------------------------------------------------------------------
+
+
+def _step_event(source):
+    return pb.StateEvent(
+        type=pb.EventStep(source=source, msg=pb.Msg(type=pb.Suspect(epoch=0)))
+    )
+
+
+def test_partition_blocks_only_cross_group_inside_window():
+    r = BasicRecorder(node_count=4, client_count=1, reqs_per_client=1)
+    m = partition([[0], [1, 2, 3]], from_ms=1000, until_ms=5000)
+
+    cross = _step_event(source=1)  # 1 -> 0 crosses the cut
+    intra = _step_event(source=2)  # 2 -> 3 stays inside a group
+    tick = pb.StateEvent(type=pb.EventTick())
+
+    assert m(r, 500, 0, cross) == (500, 0, cross)  # before the split
+    assert m(r, 1000, 0, cross) is None  # split is live
+    assert m(r, 4999, 1, _step_event(source=0)) is None  # both directions
+    assert m(r, 3000, 3, intra) == (3000, 3, intra)  # same side flows
+    assert m(r, 3000, 0, tick) == (3000, 0, tick)  # local events flow
+    assert m(r, 5000, 0, cross) == (5000, 0, cross)  # healed
+    assert m.dropped == 2
+
+
+def test_partition_ignores_unlisted_nodes():
+    r = BasicRecorder(node_count=4, client_count=1, reqs_per_client=1)
+    m = partition([[0], [1]], from_ms=0, until_ms=10_000)
+    from_unlisted = _step_event(source=2)
+    to_unlisted = _step_event(source=0)
+    assert m(r, 100, 0, from_unlisted) == (100, 0, from_unlisted)
+    assert m(r, 100, 2, to_unlisted) == (100, 2, to_unlisted)
+    assert m.dropped == 0
+
+
+# ---------------------------------------------------------------------------
+# Invariant checker detects violations (on doctored evidence)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_converged_recorder():
+    r = BasicRecorder(node_count=4, client_count=1, reqs_per_client=3)
+    r.drain_clients(max_steps=200_000)
+    return r
+
+
+def test_no_fork_passes_then_detects_doctored_fork():
+    r = _tiny_converged_recorder()
+    canonical = check_no_fork(r)
+    assert canonical  # something committed
+
+    client, req_no, seq = r.node_states[1].committed_reqs[0]
+    r.node_states[1].committed_reqs[0] = (client, req_no + 1000, seq)
+    with pytest.raises(InvariantViolation, match="fork at seq"):
+        check_no_fork(r)
+
+
+def test_no_fork_detects_duplicate_commit():
+    r = _tiny_converged_recorder()
+    r.node_states[2].committed_reqs.append(
+        r.node_states[2].committed_reqs[-1]
+    )
+    with pytest.raises(InvariantViolation):
+        check_no_fork(r)
+
+
+def test_durable_prefix_detects_lost_and_rewritten_commits():
+    r = _tiny_converged_recorder()
+    final = r.node_states[0].committed_reqs
+    good = CrashSnapshot(node=0, at_ms=100, committed=list(final[:2]))
+    check_durable_prefix(r, [good])  # a true prefix passes
+
+    lost = CrashSnapshot(
+        node=0, at_ms=100, committed=list(final) + [(99, 99, 999)]
+    )
+    with pytest.raises(InvariantViolation, match="lost commits"):
+        check_durable_prefix(r, [lost])
+
+    rewritten = CrashSnapshot(
+        node=0, at_ms=100, committed=[(98, 98, 998)] + list(final[1:2])
+    )
+    with pytest.raises(InvariantViolation, match="rewrote durable history"):
+        check_durable_prefix(r, [rewritten])
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 smoke subset: one scenario per disruption family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_smoke_partition_heals():
+    result = run_scenario(BY_NAME["partition-minority"], seed=0)
+    assert result.passed, result.violation
+    assert result.counters["partition_drops"] > 0
+
+
+@pytest.mark.chaos
+def test_smoke_crash_restart_durable():
+    result = run_scenario(BY_NAME["crash-restart"], seed=1)
+    assert result.passed, result.violation
+    assert result.counters["crashes"] == 1
+
+
+@pytest.mark.chaos
+def test_smoke_device_plane_failure_does_not_stall():
+    result = run_scenario(BY_NAME["device-digest-dies"], seed=2)
+    assert result.passed, result.violation
+    # The injected device loss tripped the breaker, work fell back to the
+    # host oracle, and a recovery probe re-closed the circuit.
+    assert result.counters["device_errors"] > 0
+    assert result.counters["fallback_digests"] > 0
+    assert result.counters["breaker_trips"] >= 1
+    assert result.counters["breaker"] == "closed"
+
+
+@pytest.mark.chaos
+def test_smoke_names_cover_three_disruption_families():
+    names = set(SMOKE_NAMES)
+    assert {s.name for s in smoke_matrix()} == names
+    assert any("partition" in n for n in names)
+    assert any("crash" in n for n in names)
+    assert any("device" in n for n in names)
+
+
+@pytest.mark.chaos
+def test_smoke_campaign_reproducible_from_seed():
+    first = run_campaign(smoke_matrix(), seed=42)
+    second = run_campaign(smoke_matrix(), seed=42)
+    assert first.passed and second.passed
+    for a, b in zip(first.results, second.results):
+        assert (a.name, a.events, a.sim_ms, a.commits) == (
+            b.name,
+            b.events,
+            b.sim_ms,
+            b.commits,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Replay-idempotency regression: the bug the campaign caught
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_restart_replay_does_not_reapply_committed_batches():
+    """A node that crashes with commits beyond its last stable checkpoint
+    — while a concurrent partition keeps the network from moving past GC,
+    so recovery replays instead of state-transferring — must not re-apply
+    batches its durable app already executed."""
+    result = run_scenario(BY_NAME["partition-plus-crash"], seed=14)
+    assert result.passed, result.violation
+
+
+# ---------------------------------------------------------------------------
+# The full matrix (slow lane; also: python -m mirbft_tpu.chaos)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_full_campaign_passes_all_invariants():
+    campaign = run_campaign(seed=0)
+    assert len(campaign.results) >= 12
+    assert campaign.passed, campaign.report()

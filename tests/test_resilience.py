@@ -1,0 +1,240 @@
+"""Resilience primitives (mirbft_tpu/resilience.py) and the fault-hardened
+crypto planes: circuit breaker lifecycle, backoff bounds, device-failure
+fallback to the host oracle, and the status.py snapshots that surface it."""
+
+import random
+
+from mirbft_tpu.chaos.faults import FlakyDigestBackend
+from mirbft_tpu.resilience import CLOSED, HALF_OPEN, OPEN, Backoff, CircuitBreaker
+from mirbft_tpu.status import crypto_plane_status
+from mirbft_tpu.testengine.crypto_plane import (
+    AsyncKernelHashPlane,
+    CoalescingHashPlane,
+    DevicePlaneError,
+    _host_digest_many,
+)
+from mirbft_tpu.testengine.signing import (
+    AsyncSignaturePlane,
+    SignaturePlane,
+    host_verifier,
+    make_signer,
+)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_after_consecutive_failures():
+    b = CircuitBreaker(failure_threshold=3, probe_interval=4)
+    assert b.state == CLOSED
+    b.record_failure()
+    b.record_success()  # success resets the consecutive count
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED
+    b.record_failure()
+    assert b.state == OPEN and b.trips == 1
+
+
+def test_breaker_probes_and_recloses():
+    b = CircuitBreaker(failure_threshold=1, probe_interval=3)
+    b.record_failure()
+    assert b.state == OPEN
+    # Denied calls accumulate until the probe_interval-th becomes a probe.
+    assert [b.allow() for _ in range(3)] == [False, False, True]
+    assert b.state == HALF_OPEN
+    assert not b.allow()  # probe in flight: others keep falling back
+    b.record_success()
+    assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    b = CircuitBreaker(failure_threshold=1, probe_interval=1)
+    b.record_failure()
+    assert b.allow()  # immediately converted to a probe
+    b.record_failure()
+    assert b.state == OPEN and b.trips == 1  # re-open, not a fresh trip
+    assert b.probes == 1
+
+
+def test_backoff_grows_to_cap_with_jitter():
+    b = Backoff(base=0.1, factor=2.0, cap=1.0, rng=random.Random(7))
+    delays = [b.next() for _ in range(8)]
+    ceilings = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0, 1.0, 1.0]
+    for delay, ceiling in zip(delays, ceilings):
+        assert 0.5 * ceiling <= delay <= ceiling
+    b.reset()
+    assert b.next() <= 0.1
+
+
+# ---------------------------------------------------------------------------
+# Digest plane: device failure degrades to the host oracle
+# ---------------------------------------------------------------------------
+
+
+def _expected_digests(msgs):
+    return _host_digest_many(msgs)
+
+
+def _drain_plane(plane, preimages):
+    """Submit preimages and pull every digest through the resolve path
+    (what resolve_event does for a delivered EventActionResults)."""
+    handles = plane.submit([[p] for p in preimages])
+    return [plane._resolve(h.index) for h in handles]
+
+
+def test_coalescing_plane_rescues_dead_device_batches():
+    flaky = FlakyDigestBackend(fail_from=0, fail_until=2, mode="die")
+    plane = CoalescingHashPlane(
+        digest_many=flaky,
+        breaker=CircuitBreaker(failure_threshold=1, probe_interval=1),
+    )
+    msgs = [b"m%d" % i for i in range(4)]
+    got = _drain_plane(plane, msgs)
+    assert got == _expected_digests(msgs)  # values correct despite failure
+    assert plane.device_errors == 1 and plane.fallback_digests == 4
+    assert plane.breaker.state == OPEN
+
+    # Next wave: breaker open, first call becomes a probe; backend is
+    # still failing (call 1 < fail_until) so it re-opens, after which the
+    # following wave's probe (call 2) succeeds and re-closes.
+    more = [b"n%d" % i for i in range(3)]
+    assert _drain_plane(plane, more) == _expected_digests(more)
+    last = [b"o%d" % i for i in range(2)]
+    assert _drain_plane(plane, last) == _expected_digests(last)
+    assert plane.breaker.state == CLOSED
+
+
+def test_coalescing_plane_short_read_detected():
+    plane = CoalescingHashPlane(
+        digest_many=lambda msgs: _host_digest_many(msgs)[:-1]
+    )
+    msgs = [b"a", b"b", b"c"]
+    assert _drain_plane(plane, msgs) == _expected_digests(msgs)
+    assert plane.device_errors == 1
+
+
+def test_coalescing_plane_timeout_counts_against_breaker():
+    plane = CoalescingHashPlane(timeout_s=0.0)  # every call "times out"
+    msgs = [b"x", b"y"]
+    assert _drain_plane(plane, msgs) == _expected_digests(msgs)
+    assert plane.device_timeouts == 1
+    assert plane.breaker.consecutive_failures == 1
+
+
+def test_async_plane_launch_failure_host_rescues():
+    def exploding_kernel(_blocks, _n_blocks):
+        raise DevicePlaneError("injected launch failure")
+
+    plane = AsyncKernelHashPlane(
+        kernel_fn=exploding_kernel, min_device_rows=1, chunk_rows=256
+    )
+    msgs = [b"wave%d" % i for i in range(8)]
+    handles = plane.submit([[m] for m in msgs])
+    plane.on_time(1)  # wave boundary: launches, explodes, host-rescues
+    got = [plane._resolve(h.index) for h in handles]
+    assert got == _expected_digests(msgs)
+    assert plane.device_errors >= 1 and plane.host_digests == len(msgs)
+
+
+# ---------------------------------------------------------------------------
+# Signature plane: verifier failure degrades to the host oracle
+# ---------------------------------------------------------------------------
+
+
+def _signed_items(n):
+    signer = make_signer()
+    return [
+        (7, req_no, signer(7, req_no, b"payload%d" % req_no))
+        for req_no in range(n)
+    ]
+
+
+def test_signature_plane_verifier_failure_falls_back_to_host():
+    calls = []
+
+    def dying_verifier(batch):
+        calls.append(len(batch))
+        raise DevicePlaneError("injected verify failure")
+
+    plane = SignaturePlane(
+        verifier=dying_verifier,
+        breaker=CircuitBreaker(failure_threshold=1, probe_interval=1),
+    )
+    items = _signed_items(3)
+    for client_id, req_no, data in items:
+        plane.submit(client_id, req_no, data)
+    assert all(plane.valid(*item) for item in items)
+    assert calls == [3]  # one device attempt, then host fallback
+    assert plane.device_errors == 1 and plane.fallback_verifies == 3
+    assert plane.breaker.state == OPEN
+
+    # Tampered data still rejected through the fallback path.
+    client_id, req_no, data = _signed_items(1)[0]
+    assert not plane.valid(client_id, req_no, data[:-1] + b"\x00")
+
+
+def test_signature_plane_short_verdicts_detected():
+    plane = SignaturePlane(verifier=lambda batch: host_verifier(batch)[:-1])
+    items = _signed_items(2)
+    for item in items:
+        plane.submit(*item)
+    assert all(plane.valid(*item) for item in items)
+    assert plane.device_errors == 1
+
+
+def test_async_signature_plane_launch_failure_host_verifies_wave():
+    def exploding_launch(_rows, sublanes):
+        raise DevicePlaneError("injected launch failure")
+
+    plane = AsyncSignaturePlane(
+        chunk=4, min_device_rows=1, launch_fn=exploding_launch
+    )
+    items = _signed_items(4)  # == chunk: submit triggers the launch
+    for item in items:
+        plane.submit(*item)
+    assert all(plane.valid(*item) for item in items)
+    assert plane.device_errors == 1
+    assert plane.host_verifies == 4 and plane.fallback_verifies == 4
+
+
+def test_async_signature_plane_readback_failure_host_rescues():
+    class PoisonArray:
+        def __len__(self):
+            raise DevicePlaneError("injected readback failure")
+
+        def __iter__(self):
+            raise DevicePlaneError("injected readback failure")
+
+    plane = AsyncSignaturePlane(
+        chunk=3, min_device_rows=1, launch_fn=lambda rows, sublanes: PoisonArray()
+    )
+    items = _signed_items(3)
+    for item in items:
+        plane.submit(*item)
+    assert all(plane.valid(*item) for item in items)
+    assert plane.device_errors == 1
+    assert plane.breaker.consecutive_failures == 1
+    assert plane.fallback_verifies == 3
+
+
+# ---------------------------------------------------------------------------
+# status.py snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_crypto_plane_status_snapshot():
+    flaky = FlakyDigestBackend(fail_from=0, fail_until=1, mode="die")
+    plane = CoalescingHashPlane(
+        digest_many=flaky,
+        breaker=CircuitBreaker(failure_threshold=1, probe_interval=1),
+    )
+    _drain_plane(plane, [b"p", b"q"])
+    snap = crypto_plane_status(plane)
+    assert snap.plane == "CoalescingHashPlane"
+    assert snap.device_errors == 1 and snap.fallback_work == 2
+    assert snap.breaker.state == OPEN and snap.breaker.trips == 1
+    assert "breaker: open" in snap.pretty()
+    assert '"device_errors": 1' in snap.to_json()
