@@ -1,6 +1,8 @@
-"""CLI entry: ``python -m mirbft_tpu.chaos [--seed N] [--smoke] [--only S]``.
+"""CLI entry: ``python -m mirbft_tpu.chaos [--seed N] [--seeds K] [--smoke]
+[--only S]``.
 
-Exit status 0 iff every selected scenario passed all invariants."""
+Exit status 0 iff every selected scenario passed all invariants (under
+every seed of the sweep, when ``--seeds`` > 1)."""
 
 from __future__ import annotations
 
@@ -18,6 +20,13 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--seed", type=int, default=0, help="campaign base seed (default 0)"
+    )
+    parser.add_argument(
+        "--seeds",
+        type=int,
+        default=1,
+        metavar="N",
+        help="sweep N campaigns at seeds seed..seed+N-1 (default 1)",
     )
     parser.add_argument(
         "--smoke",
@@ -45,9 +54,22 @@ def main(argv=None) -> int:
             print(f"{scenario.name:<28} {scenario.description}")
         return 0
 
-    campaign = run_campaign(scenarios, seed=args.seed)
-    print(campaign.report())
-    return 0 if campaign.passed else 1
+    if args.seeds < 1:
+        print("--seeds must be >= 1", file=sys.stderr)
+        return 2
+    all_passed = True
+    good_campaigns = 0
+    for seed in range(args.seed, args.seed + args.seeds):
+        campaign = run_campaign(scenarios, seed=seed)
+        print(campaign.report())
+        all_passed = all_passed and campaign.passed
+        good_campaigns += campaign.passed
+    if args.seeds > 1:
+        print(
+            f"sweep seeds={args.seed}..{args.seed + args.seeds - 1}: "
+            f"{good_campaigns}/{args.seeds} campaigns passed"
+        )
+    return 0 if all_passed else 1
 
 
 if __name__ == "__main__":

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..obsv import hooks
+from ..obsv.metrics import Registry
 from ..testengine.engine import BasicRecorder
 from .invariants import (
     CrashSnapshot,
@@ -65,10 +67,18 @@ class CampaignResult:
         return "\n".join(lines)
 
 
-def run_scenario(scenario: Scenario, seed: int = 0) -> ScenarioResult:
+def run_scenario(
+    scenario: Scenario, seed: int = 0, registry: Registry | None = None
+) -> ScenarioResult:
     """Execute one scenario under one seed and audit every invariant.
     Never raises for an invariant violation — it is reported in the
-    result — but scenario-construction bugs do propagate."""
+    result — but scenario-construction bugs do propagate.
+
+    Recovery time and drop/duplicate casualties are recorded through the
+    metrics registry: the one passed in, else the globally-enabled obsv
+    registry, else a throwaway local one."""
+    if registry is None:
+        registry = hooks.metrics if hooks.enabled else Registry()
     manglers = scenario.manglers() if scenario.manglers else []
     hash_plane = scenario.hash_plane() if scenario.hash_plane else None
     rec = BasicRecorder(
@@ -139,8 +149,16 @@ def run_scenario(scenario: Scenario, seed: int = 0) -> ScenarioResult:
         check_durable_prefix(rec, snapshots)
         check_full_convergence(rec)
         ends = scenario.disruption_ends()
+        # Recovery time flows through the metrics registry so the same
+        # number shows up in chaos reports, status snapshots, and tests:
+        # the gauge IS the value the bounded-recovery invariant audits.
+        gauge = registry.gauge(
+            "mirbft_chaos_recovery_ms", scenario=scenario.name
+        )
+        gauge.set(rec.now - (max(ends) if ends else 0))
+        result.counters["recovery_ms"] = gauge.value
         check_bounded_recovery(
-            completion_ms=rec.now,
+            completion_ms=(max(ends) if ends else 0) + gauge.value,
             last_disruption_end_ms=max(ends) if ends else 0,
             bound_ms=scenario.recovery_bound_ms,
         )
@@ -151,11 +169,26 @@ def run_scenario(scenario: Scenario, seed: int = 0) -> ScenarioResult:
     result.events = rec.event_count
     result.sim_ms = rec.now
     result.commits = last_total
+    dropped = duplicated = 0
     for mangler in manglers:
         if hasattr(mangler, "dropped"):
+            dropped += mangler.dropped
             result.counters["partition_drops"] = result.counters.get(
                 "partition_drops", 0
             ) + mangler.dropped
+        if getattr(mangler, "duplicated", 0):
+            duplicated += mangler.duplicated
+            result.counters["duplicates"] = result.counters.get(
+                "duplicates", 0
+            ) + mangler.duplicated
+    if dropped:
+        registry.counter(
+            "mirbft_chaos_dropped_total", scenario=scenario.name
+        ).inc(dropped)
+    if duplicated:
+        registry.counter(
+            "mirbft_chaos_duplicated_total", scenario=scenario.name
+        ).inc(duplicated)
     if snapshots:
         result.counters["crashes"] = len(snapshots)
     if hash_plane is not None:

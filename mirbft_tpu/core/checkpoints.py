@@ -19,6 +19,7 @@ with :269-275), which lets a single node inflate agreement counts.
 from __future__ import annotations
 
 from .. import pb
+from ..obsv import hooks
 from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
 from .persisted import Persisted
 from .quorum import intersection_quorum, some_correct_quorum
@@ -70,6 +71,8 @@ class Checkpoint:
                 self.network_config
             ):
                 self.stable = True
+                if hooks.enabled:
+                    hooks.milestone("ckpt.stable", self.my_id, self.seq_no)
 
 
 class CheckpointTracker:

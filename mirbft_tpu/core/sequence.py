@@ -25,6 +25,7 @@ from __future__ import annotations
 import enum
 
 from .. import pb
+from ..obsv import hooks
 from .actions import Actions
 from .persisted import Persisted
 from .quorum import intersection_quorum
@@ -128,6 +129,8 @@ class Sequence:
         self.state = SeqState.ALLOCATED
         self.batch = request_acks
         self.outstanding_reqs = outstanding_reqs
+        if hooks.enabled:
+            hooks.milestone("seq.allocated", self.my_config.id, self.seq_no)
 
         if not request_acks:
             # Null batch: nothing to digest.
@@ -176,6 +179,8 @@ class Sequence:
             requests=self.batch,
         )
         self.state = SeqState.PREPREPARED
+        if hooks.enabled:
+            hooks.milestone("seq.preprepared", self.my_config.id, self.seq_no)
 
         actions = Actions()
         if self.owner == self.my_config.id:
@@ -241,6 +246,8 @@ class Sequence:
             return Actions()
 
         self.state = SeqState.PREPARED
+        if hooks.enabled:
+            hooks.milestone("seq.prepared", self.my_config.id, self.seq_no)
 
         actions = Actions().send(
             self.network_config.nodes,
@@ -280,3 +287,7 @@ class Sequence:
             return
 
         self.state = SeqState.COMMITTED
+        if hooks.enabled:
+            hooks.milestone(
+                "seq.commit_quorum", self.my_config.id, self.seq_no
+            )

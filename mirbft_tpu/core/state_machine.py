@@ -16,8 +16,10 @@ epoch-advance fixed point until quiescent.
 from __future__ import annotations
 
 import enum
+import time
 
 from .. import pb
+from ..obsv import hooks
 from .actions import Actions
 from .batch_tracker import BatchTracker
 from .checkpoints import CheckpointTracker
@@ -136,6 +138,36 @@ class StateMachine:
     # -- the event loop ------------------------------------------------------
 
     def apply_event(self, event: pb.StateEvent) -> Actions:
+        # The contract stays clock-free: the observed wrapper reads
+        # perf_counter for telemetry only; nothing feeds back into the
+        # protocol.  When obsv is off this is one branch.
+        if not hooks.enabled:
+            return self._apply_event(event)
+        t0 = time.perf_counter()
+        actions = self._apply_event(event)
+        m = hooks.metrics
+        m.histogram("mirbft_sm_apply_seconds").observe(
+            time.perf_counter() - t0
+        )
+        m.counter(
+            "mirbft_sm_events_total", type=type(event.type).__name__
+        ).inc()
+        if not actions.is_empty():
+            for kind, emitted in (
+                ("send", actions.sends),
+                ("hash", actions.hashes),
+                ("commit", actions.commits),
+                ("persist", actions.write_ahead),
+                ("store_request", actions.store_requests),
+                ("forward_request", actions.forward_requests),
+            ):
+                if emitted:
+                    m.counter("mirbft_sm_actions_total", kind=kind).inc(
+                        len(emitted)
+                    )
+        return actions
+
+    def _apply_event(self, event: pb.StateEvent) -> Actions:
         inner = event.type
         # Exact-type dispatch ordered by frequency (pb event classes have
         # no subclasses; this chain runs once per event of every node).

@@ -1,0 +1,43 @@
+"""Observability plane: metrics registry, trace spans, timeline profiler.
+
+Usage:
+
+    from mirbft_tpu.obsv import hooks
+    registry, tracer = hooks.enable(trace=True)
+    ...  # run instrumented code
+    print(registry.prometheus_text())
+    tracer.write("/tmp/trace.json")  # open in ui.perfetto.dev
+    hooks.disable()
+
+Instrumented call sites across core/testengine/runtime/chaos guard on
+``hooks.enabled`` so that with observability off the entire plane costs
+one branch per boundary crossing.  ``python -m mirbft_tpu.obsv`` runs an
+instrumented testengine ladder and prints the per-phase consensus
+latency table.
+"""
+
+from __future__ import annotations
+
+from . import hooks
+from .metrics import (
+    CATALOG,
+    DEFAULT_BUCKETS,
+    NullRegistry,
+    Registry,
+    null_registry,
+)
+from .timeline import PHASES, PhaseStats, TimelineProfiler
+from .trace import Tracer
+
+__all__ = [
+    "CATALOG",
+    "DEFAULT_BUCKETS",
+    "NullRegistry",
+    "PHASES",
+    "PhaseStats",
+    "Registry",
+    "TimelineProfiler",
+    "Tracer",
+    "hooks",
+    "null_registry",
+]

@@ -1,0 +1,86 @@
+"""``python -m mirbft_tpu.obsv`` — instrumented testengine ladder.
+
+Runs a seeded Recorder with the observability plane enabled, prints the
+per-phase consensus latency table (p50/p95/p99), and optionally writes a
+Chrome trace-event file (``--trace``, open in ui.perfetto.dev), the
+Prometheus exposition (``--prom``), or the registry JSON (``--json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import hooks
+from .timeline import TimelineProfiler
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mirbft_tpu.obsv",
+        description="Run an instrumented testengine ladder and report "
+        "per-phase consensus latency.",
+    )
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--clients", type=int, default=4)
+    # Long enough that the run must pass stable checkpoints to keep
+    # committing (>2 checkpoint windows), so the checkpoint phase has
+    # samples in the table.
+    parser.add_argument("--reqs", type=int, default=60,
+                        help="requests per client")
+    parser.add_argument("--batch-size", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--trace", metavar="PATH",
+                        help="write a Chrome trace-event JSON file")
+    parser.add_argument("--prom", action="store_true",
+                        help="print Prometheus text exposition")
+    parser.add_argument("--json", action="store_true",
+                        help="print the registry snapshot as JSON")
+    args = parser.parse_args(argv)
+
+    # Import after argparse so --help stays instant.
+    from ..testengine.engine import BasicRecorder
+
+    registry, tracer = hooks.enable(trace=True)
+    try:
+        rec = BasicRecorder(
+            args.nodes,
+            args.clients,
+            args.reqs,
+            seed=args.seed,
+            batch_size=args.batch_size,
+            record=False,
+        )
+        for node in range(args.nodes):
+            tracer.name_thread(node, f"node {node}")
+        events = rec.drain_clients(max_steps=2_000_000)
+        registry.gauge("mirbft_engine_sim_ms").set(rec.now)
+        registry.counter("mirbft_engine_events_total").inc(events)
+
+        profiler = TimelineProfiler.from_tracer(tracer)
+        print(
+            f"run: nodes={args.nodes} clients={args.clients} "
+            f"reqs={args.reqs} batch_size={args.batch_size} "
+            f"seed={args.seed} -> {events} events, sim {rec.now} ms"
+        )
+        print()
+        print("consensus phase latency (simulated ms):")
+        print(profiler.table())
+
+        if args.trace:
+            tracer.write(args.trace)
+            print(f"\ntrace written to {args.trace} "
+                  "(open in ui.perfetto.dev)")
+        if args.prom:
+            print()
+            print(registry.prometheus_text(), end="")
+        if args.json:
+            print()
+            print(registry.to_json(indent=2))
+    finally:
+        hooks.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

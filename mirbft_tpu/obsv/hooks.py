@@ -1,0 +1,92 @@
+"""Process-global observability switchboard.
+
+Instrumented modules import this module (never the registry or tracer
+directly) and guard every hot-path record behind ``if hooks.enabled:`` —
+one module-attribute load and a branch when observability is off, which
+keeps the disabled overhead unmeasurable (<2% on the testengine ladder,
+asserted by the acceptance bench).
+
+``enable()`` installs a live :class:`~mirbft_tpu.obsv.metrics.Registry`
+(and optionally a :class:`~mirbft_tpu.obsv.trace.Tracer`); ``disable()``
+restores the no-op state.  ``sim_now`` is the testengine's simulated
+clock in ms — the Recorder publishes it as it advances, so milestone
+instants carry simulated time alongside the monotonic wall timestamp.
+
+Everything here is clock-free except through the tracer/registry, which
+use ``time.perf_counter``-family monotonic sources only (enforced by the
+W7 lint rule).
+"""
+
+from __future__ import annotations
+
+enabled = False
+metrics = None  # Registry when enabled, else None
+tracer = None  # Tracer when tracing was requested, else None
+sim_now = None  # simulated ms (testengine runs), None under the runtime
+
+
+def enable(registry=None, trace=False):
+    """Turn observability on.  Returns ``(metrics, tracer)``.
+
+    ``registry`` defaults to a fresh Registry; ``trace=True`` also
+    installs a fresh Tracer (span/instant capture is more expensive than
+    counters, so it is opt-in even when metrics are on).
+    """
+    global enabled, metrics, tracer, sim_now
+    from .metrics import Registry
+    from .trace import Tracer
+
+    metrics = registry if registry is not None else Registry()
+    tracer = Tracer() if trace else None
+    sim_now = None
+    enabled = True
+    return metrics, tracer
+
+
+def disable():
+    """Restore the no-op state (instrumentation sites become one branch)."""
+    global enabled, metrics, tracer, sim_now
+    enabled = False
+    metrics = None
+    tracer = None
+    sim_now = None
+
+
+def milestone(name, node, seq):
+    """Emit a protocol-milestone instant event (no-op without a tracer).
+
+    Call sites still guard with ``if hooks.enabled:`` so the disabled
+    cost stays a single branch; this function only re-checks the tracer.
+    """
+    t = tracer
+    if t is not None:
+        t.instant(
+            name,
+            cat="consensus",
+            tid=node,
+            args={"node": node, "seq": seq, "sim_ms": sim_now},
+        )
+
+
+def record_flush(plane, path, items, seconds=None):
+    """Record one crypto-plane flush/launch/readback: how many digests or
+    verdicts moved through which path (device, host, readback, rescued,
+    inline), and how long the blocking part took.  ``seconds=None`` means
+    the call had no blocking component worth timing (e.g. inline bypass).
+    """
+    m = metrics
+    if m is None:
+        return
+    m.counter("mirbft_crypto_flush_total", plane=plane, path=path).inc()
+    m.counter("mirbft_crypto_items_total", plane=plane, path=path).inc(items)
+    if seconds is not None:
+        m.histogram("mirbft_crypto_flush_seconds", plane=plane).observe(seconds)
+    t = tracer
+    if t is not None and seconds is not None:
+        t.complete(
+            "crypto." + plane + "." + path,
+            cat="crypto",
+            tid=-1,
+            dur_s=seconds,
+            args={"items": items, "sim_ms": sim_now},
+        )

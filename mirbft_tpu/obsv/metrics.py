@@ -1,0 +1,302 @@
+"""Zero-dependency metrics registry: counters, gauges, fixed-bucket
+histograms, with Prometheus text exposition and a JSON dump.
+
+Design points:
+
+- **Catalog-enforced names.** Every metric the codebase registers must be
+  declared in :data:`CATALOG` (name -> help text); a strict registry
+  raises on unknown names.  The catalog is the single source of truth the
+  docs test checks against ``docs/OBSERVABILITY.md``, so an undocumented
+  metric cannot ship.
+- **Cheap no-op handles.** ``null_registry()`` hands out shared singleton
+  handles whose ``inc``/``set``/``observe`` are empty methods — callers
+  that cache a handle pay one no-op call when observability is off.  The
+  even cheaper path (used on hot loops) is the ``hooks.enabled`` branch,
+  which skips the handle lookup entirely.
+- **Label sets are kwargs.** ``registry.counter("x_total", path="device")``
+  keys the series on the sorted label items, so the same call site always
+  returns the same underlying series.
+- **Monotonic-only.** Nothing in this module reads a clock; durations are
+  observed by callers from ``time.perf_counter`` deltas (W7 lint).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+
+# name -> help text.  Keep sorted; tests assert every key appears in
+# docs/OBSERVABILITY.md.
+CATALOG = {
+    "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
+    "mirbft_chaos_dropped_total": "Messages dropped by chaos manglers, per scenario.",
+    "mirbft_chaos_duplicated_total": "Messages duplicated by chaos manglers, per scenario.",
+    "mirbft_chaos_recovery_ms": "Chaos scenario recovery time: completion minus last disruption end (simulated ms).",
+    "mirbft_crypto_flush_seconds": "Blocking wall time of one crypto-plane flush/launch/readback.",
+    "mirbft_crypto_flush_total": "Crypto-plane flush/launch/readback operations, by plane and path.",
+    "mirbft_crypto_items_total": "Digests or signature verdicts produced, by plane and path (device/host/readback/rescued/inline/batch).",
+    "mirbft_engine_events_total": "Events processed by a testengine Recorder run.",
+    "mirbft_engine_sim_ms": "Final simulated clock of a testengine Recorder run.",
+    "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
+    "mirbft_reqstore_appends_total": "Request-store record appends.",
+    "mirbft_reqstore_fsync_seconds": "Wall time per request-store fsync.",
+    "mirbft_reqstore_fsyncs_total": "Request-store fsync calls.",
+    "mirbft_sm_actions_total": "Actions emitted by StateMachine.apply_event, by kind.",
+    "mirbft_sm_apply_seconds": "Wall time per StateMachine.apply_event call.",
+    "mirbft_sm_events_total": "State-machine events applied, by event type.",
+    "mirbft_transport_frames_total": "Transport frames, by outcome (enqueued/sent/dropped_overflow/dropped_closed/send_failure/dropped_unknown).",
+    "mirbft_transport_reconnects_total": "Transport dial attempts, by outcome (connected/failed).",
+    "mirbft_wal_appends_total": "WAL record appends.",
+    "mirbft_wal_fsync_seconds": "Wall time per WAL fsync.",
+    "mirbft_wal_fsyncs_total": "WAL fsync calls.",
+}
+
+# Latency buckets (seconds): 5us .. 5s, roughly geometric.  Chosen to
+# resolve both sub-ms host hashing and multi-second device round trips.
+DEFAULT_BUCKETS = (
+    0.000005,
+    0.00002,
+    0.0001,
+    0.0005,
+    0.002,
+    0.01,
+    0.05,
+    0.25,
+    1.0,
+    5.0,
+)
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class Gauge:
+    """Point-in-time value (set, not accumulated)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value):
+        self.value = value
+
+
+class Histogram:
+    """Fixed upper-bound bucket histogram with sum and count.
+
+    ``bucket_counts[i]`` counts observations <= ``uppers[i]``
+    (non-cumulative per bucket; exposition cumulates per Prometheus
+    convention).  Observations above the last bound land only in +Inf
+    (i.e. in ``count``/``sum`` but no finite bucket).
+    """
+
+    __slots__ = ("uppers", "bucket_counts", "sum", "count")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.uppers = tuple(sorted(buckets))
+        self.bucket_counts = [0] * len(self.uppers)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        self.sum += value
+        self.count += 1
+        i = bisect.bisect_left(self.uppers, value)
+        if i < len(self.uppers):
+            self.bucket_counts[i] += 1
+
+
+class _NullCounter:
+    __slots__ = ()
+    value = 0
+
+    def inc(self, n=1):
+        pass
+
+
+class _NullGauge:
+    __slots__ = ()
+    value = 0.0
+
+    def set(self, value):
+        pass
+
+
+class _NullHistogram:
+    __slots__ = ()
+    uppers = ()
+    bucket_counts = ()
+    sum = 0.0
+    count = 0
+
+    def observe(self, value):
+        pass
+
+
+NULL_COUNTER = _NullCounter()
+NULL_GAUGE = _NullGauge()
+NULL_HISTOGRAM = _NullHistogram()
+
+
+class NullRegistry:
+    """Shared no-op registry: every factory returns the same singleton
+    handle, so disabled instrumentation allocates nothing."""
+
+    def counter(self, name, **labels):
+        return NULL_COUNTER
+
+    def gauge(self, name, **labels):
+        return NULL_GAUGE
+
+    def histogram(self, name, buckets=DEFAULT_BUCKETS, **labels):
+        return NULL_HISTOGRAM
+
+    def snapshot(self):
+        return {}
+
+    def to_json(self):
+        return "{}"
+
+    def prometheus_text(self):
+        return ""
+
+
+NULL_REGISTRY = NullRegistry()
+
+
+def null_registry():
+    return NULL_REGISTRY
+
+
+class Registry:
+    """Live registry.  Thread-safe for registration (runtime processors
+    record from pool lanes); individual metric mutation is a single
+    int/float update, which CPython makes atomic enough for counters.
+    """
+
+    def __init__(self, strict=True):
+        self._strict = strict
+        self._lock = threading.Lock()
+        # name -> {label_items_tuple -> metric}
+        self._families = {}
+        # name -> "counter" | "gauge" | "histogram"
+        self._kinds = {}
+
+    def _get(self, name, labels, kind, factory):
+        if self._strict and name not in CATALOG:
+            raise KeyError(
+                f"metric {name!r} is not in obsv.metrics.CATALOG; "
+                "declare it (and document it in docs/OBSERVABILITY.md)"
+            )
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            family = self._families.get(name)
+            if family is None:
+                family = self._families[name] = {}
+                self._kinds[name] = kind
+            elif self._kinds[name] != kind:
+                raise TypeError(
+                    f"metric {name!r} already registered as {self._kinds[name]}"
+                )
+            metric = family.get(key)
+            if metric is None:
+                metric = family[key] = factory()
+            return metric
+
+    def counter(self, name, **labels):
+        return self._get(name, labels, "counter", Counter)
+
+    def gauge(self, name, **labels):
+        return self._get(name, labels, "gauge", Gauge)
+
+    def histogram(self, name, buckets=DEFAULT_BUCKETS, **labels):
+        return self._get(name, labels, "histogram", lambda: Histogram(buckets))
+
+    def snapshot(self):
+        """Plain-data dump: name -> {kind, help, series: [{labels, ...}]}."""
+        out = {}
+        with self._lock:
+            for name in sorted(self._families):
+                kind = self._kinds[name]
+                series = []
+                for key in sorted(self._families[name]):
+                    metric = self._families[name][key]
+                    entry = {"labels": dict(key)}
+                    if kind == "histogram":
+                        entry["count"] = metric.count
+                        entry["sum"] = metric.sum
+                        entry["buckets"] = {
+                            str(u): c
+                            for u, c in zip(metric.uppers, metric.bucket_counts)
+                        }
+                    else:
+                        entry["value"] = metric.value
+                    series.append(entry)
+                out[name] = {
+                    "kind": kind,
+                    "help": CATALOG.get(name, ""),
+                    "series": series,
+                }
+        return out
+
+    def to_json(self, indent=None):
+        return json.dumps(self.snapshot(), indent=indent)
+
+    def prometheus_text(self):
+        """Prometheus text exposition format 0.0.4."""
+        lines = []
+        snap = self.snapshot()
+        for name, family in snap.items():
+            lines.append(f"# HELP {name} {family['help']}")
+            lines.append(f"# TYPE {name} {family['kind']}")
+            for entry in family["series"]:
+                labels = entry["labels"]
+                if family["kind"] == "histogram":
+                    cumulative = 0
+                    for upper, count in entry["buckets"].items():
+                        cumulative += count
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_render_labels({**labels, 'le': upper})} "
+                            f"{cumulative}"
+                        )
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_render_labels({**labels, 'le': '+Inf'})} "
+                        f"{entry['count']}"
+                    )
+                    lines.append(
+                        f"{name}_sum{_render_labels(labels)} {entry['sum']}"
+                    )
+                    lines.append(
+                        f"{name}_count{_render_labels(labels)} {entry['count']}"
+                    )
+                else:
+                    lines.append(
+                        f"{name}{_render_labels(labels)} {entry['value']}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _render_labels(labels):
+    if not labels:
+        return ""
+    body = ",".join(
+        f'{k}="{_escape(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return "{" + body + "}"
+
+
+def _escape(value):
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")

@@ -1,0 +1,139 @@
+"""Trace spans with monotonic timestamps and Chrome trace-event export.
+
+Events accumulate in memory as plain dicts already shaped like Chrome
+trace-event JSON (the ``traceEvents`` array format), so ``write()`` is a
+single ``json.dump``.  Load the output in Perfetto (ui.perfetto.dev) or
+``chrome://tracing``.
+
+Event vocabulary used here:
+
+- ``ph: "X"`` complete events — one span with ``ts``/``dur`` in
+  microseconds.  Emitted at span *close*, which is why per-tid nesting is
+  reconstructed from interval containment, not emission order.
+- ``ph: "i"`` instant events with scope ``"t"`` (thread) — protocol
+  milestones (``seq.preprepared`` etc.), carrying ``args`` including the
+  simulated clock when the testengine is driving.
+- ``ph: "M"`` metadata — thread names, so Perfetto rows read "node 0"
+  instead of bare tids.
+
+All timestamps come from ``time.perf_counter_ns`` relative to the
+tracer's birth — monotonic by construction (W7 lint forbids
+``time.time`` here).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class _Span:
+    """Context manager handle; created by Tracer.span()."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_tid", "_args", "_start_ns")
+
+    def __init__(self, tracer, name, cat, tid, args):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._tid = tid
+        self._args = args
+        self._start_ns = 0
+
+    def __enter__(self):
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._tracer._complete_ns(
+            self._name,
+            self._cat,
+            self._tid,
+            self._start_ns,
+            time.perf_counter_ns(),
+            self._args,
+        )
+        return False
+
+
+class Tracer:
+    """In-memory Chrome trace-event collector.
+
+    Not thread-safe per event list mutation beyond CPython's list.append
+    atomicity — which is exactly what the runtime's pool lanes need, and
+    the testengine is single-threaded anyway.
+    """
+
+    def __init__(self):
+        self._t0_ns = time.perf_counter_ns()
+        self.events = []
+        self._thread_names = {}
+
+    def _now_us(self):
+        return (time.perf_counter_ns() - self._t0_ns) / 1000.0
+
+    def name_thread(self, tid, name):
+        """Label a tid (Perfetto row name); idempotent."""
+        if self._thread_names.get(tid) != name:
+            self._thread_names[tid] = name
+
+    def span(self, name, cat="", tid=0, **args):
+        """Context manager producing one ph:"X" complete event."""
+        return _Span(self, name, cat, tid, args or None)
+
+    def _complete_ns(self, name, cat, tid, start_ns, end_ns, args):
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "pid": 0,
+            "tid": tid,
+            "ts": (start_ns - self._t0_ns) / 1000.0,
+            "dur": max(0.0, (end_ns - start_ns) / 1000.0),
+        }
+        if args:
+            event["args"] = args
+        self.events.append(event)
+
+    def complete(self, name, cat="", tid=0, dur_s=0.0, args=None):
+        """Record an already-measured span ending now (duration dur_s).
+        The start is clamped to the tracer's birth so ``ts`` stays
+        non-negative (Chrome trace validity) even for a span measured
+        before the tracer existed."""
+        end_ns = time.perf_counter_ns()
+        start_ns = max(end_ns - int(dur_s * 1e9), self._t0_ns)
+        self._complete_ns(name, cat, tid, start_ns, end_ns, args)
+
+    def instant(self, name, cat="", tid=0, args=None):
+        """Record a ph:"i" thread-scoped instant event."""
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "i",
+            "s": "t",
+            "pid": 0,
+            "tid": tid,
+            "ts": self._now_us(),
+        }
+        if args:
+            event["args"] = args
+        self.events.append(event)
+
+    def chrome_trace(self):
+        """The full trace as a Chrome trace-event JSON object."""
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 0,
+                "tid": tid,
+                "args": {"name": name},
+            }
+            for tid, name in sorted(self._thread_names.items())
+        ]
+        return {"traceEvents": meta + self.events}
+
+    def write(self, path):
+        """Serialize to ``path`` as Perfetto-loadable JSON."""
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f)

@@ -28,6 +28,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.sha256 import _sha256_blocks
 
+# jax >= 0.5 promotes shard_map to jax.shard_map (kwarg check_vma); on the
+# 0.4.x line it lives in jax.experimental with the kwarg spelled check_rep.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _CHECK_OFF = {"check_vma": False}
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_OFF = {"check_rep": False}
+
 AXIS = "crypto"
 
 
@@ -66,14 +76,14 @@ def sharded_sha256(mesh: Mesh):
 
     @functools.partial(jax.jit, static_argnames=())
     def digest(blocks, n_blocks):
-        return jax.shard_map(
+        return _shard_map(
             digest_local,
             mesh=mesh,
             in_specs=(P(AXIS), P(AXIS)),
             out_specs=P(AXIS),
             # The scan carry starts from the replicated IV constant; varying-
             # manual-axis checking would demand a pcast for no semantic gain.
-            check_vma=False,
+            **_CHECK_OFF,
         )(blocks, n_blocks)
 
     def run(blocks, n_blocks):
@@ -100,7 +110,7 @@ def sharded_quorum_tally(mesh: Mesh):
         return total >= threshold
 
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             tally_local,
             mesh=mesh,
             in_specs=(P(AXIS, None), P()),
@@ -133,7 +143,7 @@ def sharded_ed25519_verify(mesh: Mesh):
 
     point_spec = (P(AXIS, None),) * 4
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             ladder_impl,
             mesh=mesh,
             in_specs=(
@@ -146,7 +156,7 @@ def sharded_ed25519_verify(mesh: Mesh):
             # The ladder mixes replicated curve constants into per-shard
             # state; varying-manual-axes checking would demand pcasts for
             # no semantic gain (same rationale as sharded_sha256).
-            check_vma=False,
+            **_CHECK_OFF,
         )
     )
 

@@ -19,9 +19,46 @@ batching instead of goroutines.
 
 from __future__ import annotations
 
+import functools
+import threading
+import time
+
 from .. import pb
 from ..core import actions as act
 from ..core.preimage import host_digest
+from ..obsv import hooks
+
+
+def _observed_phase(phase):
+    """Wrap a processor phase with per-phase latency recording (and a
+    trace span when a tracer is installed).  Spans use the executing
+    thread's ident as tid so pool-lane phases land on distinct trace rows
+    and stay well-nested."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(self, *args, **kwargs):
+            if not hooks.enabled:
+                return fn(self, *args, **kwargs)
+            tracer = hooks.tracer
+            start = time.perf_counter()
+            try:
+                if tracer is not None:
+                    with tracer.span(
+                        "proc." + phase,
+                        cat="runtime",
+                        tid=threading.get_ident() & 0xFFFF,
+                    ):
+                        return fn(self, *args, **kwargs)
+                return fn(self, *args, **kwargs)
+            finally:
+                hooks.metrics.histogram(
+                    "mirbft_proc_phase_seconds", phase=phase
+                ).observe(time.perf_counter() - start)
+
+        return inner
+
+    return wrap
 
 
 class Link:
@@ -52,6 +89,7 @@ class SerialProcessor:
 
     # -- phases --------------------------------------------------------------
 
+    @_observed_phase("persist")
     def _persist(self, actions: act.Actions) -> None:
         for fr in actions.store_requests:
             self.request_store.store(fr.request_ack, fr.request_data)
@@ -64,6 +102,7 @@ class SerialProcessor:
                 self.wal.write(write.append.index, write.append.data)
         self.wal.sync()
 
+    @_observed_phase("transmit")
     def _transmit(self, actions: act.Actions) -> None:
         my_id = self.node.config.id
         for send in actions.sends:
@@ -87,12 +126,14 @@ class SerialProcessor:
                 else:
                     self.link.send(replica, msg)
 
+    @_observed_phase("hash")
     def _hash(self, actions: act.Actions) -> list:
         return [
             act.HashResult(digest=host_digest(hr.data), request=hr)
             for hr in actions.hashes
         ]
 
+    @_observed_phase("commit")
     def _commit(self, actions: act.Actions, defer_prune: list | None = None) -> list:
         """Apply batches and snap checkpoints.  With ``defer_prune`` set,
         committed acks are collected there instead of pruned from the
@@ -102,6 +143,12 @@ class SerialProcessor:
         for commit in actions.commits:
             if commit.batch is not None:
                 self.app_log.apply(commit.batch)
+                if hooks.enabled:
+                    hooks.milestone(
+                        "seq.committed",
+                        self.node.config.id,
+                        commit.batch.seq_no,
+                    )
                 for ack in commit.batch.requests:
                     if defer_prune is not None:
                         defer_prune.append(ack)
@@ -204,13 +251,24 @@ class _DeviceHashMixin:
         from ..ops.batching import pack_preimages
         from ..ops.sha256 import sha256_digest_words
 
+        start = time.perf_counter() if hooks.enabled else 0.0
         packed = pack_preimages([b"".join(hr.data) for hr in hashes])
-        return sha256_digest_words(packed.blocks, packed.n_blocks)
+        words = sha256_digest_words(packed.blocks, packed.n_blocks)
+        if hooks.enabled:
+            hooks.record_flush(
+                "hash", "device", len(hashes), time.perf_counter() - start
+            )
+        return words
 
     def _collect_device(self, hashes: list, words) -> list:
         import numpy as np
 
+        start = time.perf_counter() if hooks.enabled else 0.0
         raw = np.asarray(words).astype(">u4").tobytes()
+        if hooks.enabled:
+            hooks.record_flush(
+                "hash", "readback", len(hashes), time.perf_counter() - start
+            )
         return [
             act.HashResult(digest=raw[32 * i : 32 * i + 32], request=hr)
             for i, hr in enumerate(hashes)

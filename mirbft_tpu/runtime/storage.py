@@ -19,9 +19,11 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 
 from .. import pb, wire
+from ..obsv import hooks
 
 _REC_HEADER = struct.Struct("<IQI")  # payload_len, index, crc32(payload)
 _SEGMENT_TARGET = 4 * 1024 * 1024
@@ -140,6 +142,8 @@ class FileWal:
         self._active_size += len(record) + len(payload)
         self._entries.append((index, entry))
         self._needs_sync = True
+        if hooks.enabled:
+            hooks.metrics.counter("mirbft_wal_appends_total").inc()
 
     def truncate(self, index: int) -> None:
         """Truncate-front: drop every entry with index < the given index."""
@@ -171,9 +175,16 @@ class FileWal:
     def sync(self) -> None:
         with self._lock:
             if self._active is not None and self._needs_sync:
+                start = time.perf_counter() if hooks.enabled else 0.0
                 self._active.flush()
                 os.fsync(self._active.fileno())
                 self._needs_sync = False
+                if hooks.enabled:
+                    m = hooks.metrics
+                    m.counter("mirbft_wal_fsyncs_total").inc()
+                    m.histogram("mirbft_wal_fsync_seconds").observe(
+                        time.perf_counter() - start
+                    )
 
     def close(self) -> None:
         self.sync()
@@ -263,6 +274,8 @@ class FileRequestStore:
         with self._lock:
             self._write_record(self._file, _OP_STORE, ack, data or b"")
             self._index[self._key(ack)] = (ack, data or b"")
+            if hooks.enabled:
+                hooks.metrics.counter("mirbft_reqstore_appends_total").inc()
 
     def get(self, ack: pb.RequestAck) -> bytes | None:
         with self._lock:
@@ -276,8 +289,15 @@ class FileRequestStore:
 
     def sync(self) -> None:
         with self._lock:
+            start = time.perf_counter() if hooks.enabled else 0.0
             self._file.flush()
             os.fsync(self._file.fileno())
+            if hooks.enabled:
+                m = hooks.metrics
+                m.counter("mirbft_reqstore_fsyncs_total").inc()
+                m.histogram("mirbft_reqstore_fsync_seconds").observe(
+                    time.perf_counter() - start
+                )
 
     def uncommitted(self, for_each) -> None:
         """Invoke for_each(ack) for every stored-but-uncommitted request, in

@@ -42,11 +42,28 @@ import threading
 import time
 
 from .. import pb, wire
+from ..obsv import hooks
 from ..resilience import Backoff
 from .processor import Link
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
+
+
+def _frame_outcome(outcome: str, n: int = 1) -> None:
+    """Mirror the per-channel counters into the metrics registry (the
+    channel attributes remain the source of truth for counters())."""
+    if hooks.enabled:
+        hooks.metrics.counter(
+            "mirbft_transport_frames_total", outcome=outcome
+        ).inc(n)
+
+
+def _dial_outcome(outcome: str) -> None:
+    if hooks.enabled:
+        hooks.metrics.counter(
+            "mirbft_transport_reconnects_total", outcome=outcome
+        ).inc()
 
 
 class _PeerChannel:
@@ -82,12 +99,15 @@ class _PeerChannel:
         with self.cv:
             if self.closed:
                 self.dropped_closed += 1
+                _frame_outcome("dropped_closed")
                 return
             if len(self.queue) >= self.transport.queue_depth:
                 self.queue.popleft()
                 self.dropped_overflow += 1
+                _frame_outcome("dropped_overflow")
             self.queue.append(frame)
             self.enqueued += 1
+            _frame_outcome("enqueued")
             self.cv.notify()
 
     def close(self, drain_timeout: float) -> None:
@@ -108,6 +128,7 @@ class _PeerChannel:
                     or time.monotonic() >= self._drain_deadline
                 ):
                     self.dropped_closed += len(self.queue)
+                    _frame_outcome("dropped_closed", len(self.queue))
                     self.queue.clear()
                     return
                 frame = self.queue.popleft()
@@ -117,6 +138,7 @@ class _PeerChannel:
                 # the rest of the queue, handled above) is dropped.
                 with self.cv:
                     self.dropped_closed += 1
+                    _frame_outcome("dropped_closed")
                 continue
             conn, send_lock = entry
             try:
@@ -124,6 +146,7 @@ class _PeerChannel:
                     conn.sendall(frame)
             except OSError:
                 self.send_failures += 1
+                _frame_outcome("send_failure")
                 self._drop_conn(entry)
                 # Put the frame back at the head so delivery resumes in
                 # order after reconnect — unless that would overflow.
@@ -132,9 +155,11 @@ class _PeerChannel:
                         self.queue.appendleft(frame)
                     else:
                         self.dropped_overflow += 1
+                        _frame_outcome("dropped_overflow")
                 continue
             with self.cv:
                 self.sent += 1
+                _frame_outcome("sent")
 
     def _ensure_connected(self):
         """Return the live (socket, lock) entry for this peer, dialing with
@@ -155,6 +180,7 @@ class _PeerChannel:
                 conn = socket.create_connection(address, timeout=5)
             except OSError:
                 self.connect_failures += 1
+                _dial_outcome("failed")
                 delay = self.backoff.next()
                 with self.cv:
                     if not self.closed:
@@ -172,6 +198,7 @@ class _PeerChannel:
                 entry = existing
             else:
                 self.connects += 1
+                _dial_outcome("connected")
             return entry
 
     def _drop_conn(self, entry) -> None:
@@ -266,6 +293,7 @@ class TcpTransport:
         channel = self._channel(dest)
         if channel is None:
             self.dropped_unknown += 1
+            _frame_outcome("dropped_unknown")
             return  # unknown peer: dropped, like any unreachable host
         channel.enqueue(frame)
 

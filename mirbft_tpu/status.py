@@ -329,6 +329,57 @@ def crypto_plane_status(plane) -> CryptoPlaneStatus:
     )
 
 
+@dataclass
+class MetricsStatus:
+    """Snapshot of the obsv metrics registry, folded into the same
+    to_json()/pretty() idiom as the tracker snapshots.  ``families`` is
+    the registry's snapshot(): name -> {kind, help, series}."""
+
+    enabled: bool
+    families: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, default=str)
+
+    def pretty(self) -> str:
+        lines = ["=== Metrics ==="]
+        if not self.enabled:
+            lines.append("  (observability disabled)")
+            return "\n".join(lines)
+        if not self.families:
+            lines.append("  (no metrics recorded)")
+        for name, family in self.families.items():
+            for entry in family["series"]:
+                labels = entry["labels"]
+                label_str = (
+                    "{"
+                    + ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                    + "}"
+                    if labels
+                    else ""
+                )
+                if family["kind"] == "histogram":
+                    count = entry["count"]
+                    mean = entry["sum"] / count if count else 0.0
+                    lines.append(
+                        f"  {name}{label_str}: count={count} mean={mean:.6f}"
+                    )
+                else:
+                    lines.append(f"  {name}{label_str}: {entry['value']}")
+        return "\n".join(lines)
+
+
+def metrics_status(registry=None) -> MetricsStatus:
+    """Snapshot an obsv Registry (default: the hooks-installed one)."""
+    from .obsv import hooks
+
+    if registry is None:
+        registry = hooks.metrics
+    if registry is None:
+        return MetricsStatus(enabled=False)
+    return MetricsStatus(enabled=True, families=registry.snapshot())
+
+
 def pretty(status: StateMachineStatus) -> str:
     """ASCII dashboard (reference: status/status.go:141-296)."""
     lines = [

@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 
 from .. import pb
+from ..obsv import hooks
 from ..resilience import CircuitBreaker
 
 
@@ -147,8 +148,11 @@ class CoalescingHashPlane:
             return
         start = time.perf_counter()
         digests = self._guarded_digest_many(self._pending)
-        self.flush_wall_s.append(time.perf_counter() - start)
+        wall = time.perf_counter() - start
+        self.flush_wall_s.append(wall)
         self.flush_sizes.append(len(self._pending))
+        if hooks.enabled:
+            hooks.record_flush("hash", "batch", len(self._pending), wall)
         for offset, digest in enumerate(digests):
             self._results[self._base + offset] = digest
         self._base += len(self._pending)
@@ -288,6 +292,8 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
                 for chunks in chunk_lists
             ]
             self.host_digests += len(out)
+            if hooks.enabled:
+                hooks.record_flush("hash", "inline", len(out))
             return out
 
         from ..ops.batching import next_pow2, sha256_pad
@@ -327,9 +333,12 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         results = self._results
         for index, msg in group:
             results[index] = hashlib.sha256(msg).digest()
-        self.flush_wall_s.append(time.perf_counter() - start)
+        wall = time.perf_counter() - start
+        self.flush_wall_s.append(wall)
         self.flush_sizes.append(len(group))
         self.host_digests += len(group)
+        if hooks.enabled:
+            hooks.record_flush("hash", "host", len(group), wall)
 
     def _launch(self, bucket: int, group: list) -> None:
         if not self.breaker.allow():
@@ -380,6 +389,8 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
         self.flush_sizes.append(len(indices))
         self.overlapped_launches += 1
         self.device_digests += len(indices)
+        if hooks.enabled:
+            hooks.record_flush("hash", "device", len(indices), launch_s)
 
     def _flush(self, at_wave_boundary: bool = False) -> None:
         """Flush every partially-filled bucket.  Proactive wave-boundary
@@ -430,9 +441,10 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
                 del self._chunk_of[i]
             self.rescued_digests += len(group)
             self.device_digests -= len(group)
-            self.flush_wall_s.append(
-                launch_s + time.perf_counter() - start
-            )
+            wall = launch_s + time.perf_counter() - start
+            self.flush_wall_s.append(wall)
+            if hooks.enabled:
+                hooks.record_flush("hash", "rescued", len(group), wall)
             return results[index]
         import numpy as np
 
@@ -456,10 +468,16 @@ class AsyncKernelHashPlane(CoalescingHashPlane):
             self.rescued_digests += len(group)
             self.device_digests -= len(group)
             self.fallback_digests += len(group)
-            self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+            wall = launch_s + time.perf_counter() - start
+            self.flush_wall_s.append(wall)
+            if hooks.enabled:
+                hooks.record_flush("hash", "rescued", len(group), wall)
             return results[index]
         self.breaker.record_success()
-        self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+        wall = launch_s + time.perf_counter() - start
+        self.flush_wall_s.append(wall)
+        if hooks.enabled:
+            hooks.record_flush("hash", "readback", len(group), wall)
         for row, (i, _msg) in enumerate(group):
             results[i] = raw[32 * row : 32 * row + 32]
             del self._chunk_of[i]

@@ -54,6 +54,7 @@ from .. import pb
 from ..core import actions as act
 from ..core.preimage import host_digest
 from ..core.state_machine import StateMachine
+from ..obsv import hooks
 
 
 @dataclass
@@ -593,6 +594,10 @@ class Recorder:
         when, _seq, node, event = heapq.heappop(self._queue)
         if when > self.now:
             self.now = when
+            if hooks.enabled:
+                # Publish the simulated clock so milestone instants carry
+                # deterministic simulated time alongside wall timestamps.
+                hooks.sim_now = when
             if self.hash_plane is not None:
                 # Simulated time advanced: every hash submitted at earlier
                 # instants is a complete wave the plane may launch now,
@@ -883,6 +888,8 @@ class Recorder:
             # node's next checkpoint off the network.
             return
         state.last_committed = batch.seq_no
+        if hooks.enabled:
+            hooks.milestone("seq.committed", node, batch.seq_no)
         for ack in batch.requests:
             triggered = self.reconfig_on_commit.get((ack.client_id, ack.req_no))
             if triggered:

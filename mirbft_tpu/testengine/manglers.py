@@ -196,11 +196,16 @@ class _Rule:
         )
 
     def drop(self):
+        """Drops matched events.  The returned mangler counts casualties on
+        its ``dropped`` attribute (mirrors partition())."""
+
         def mangler(recorder, when, node, event):
             if self._matches(recorder, when, node, event):
+                mangler.dropped += 1
                 return None
             return (when, node, event)
 
+        mangler.dropped = 0
         return mangler
 
     def delay(self, ms: int):
@@ -220,12 +225,17 @@ class _Rule:
         return mangler
 
     def duplicate(self, max_delay_ms: int):
+        """Duplicates matched events with a delayed echo.  The returned
+        mangler counts echoes on its ``duplicated`` attribute."""
+
         def mangler(recorder, when, node, event):
             if self._matches(recorder, when, node, event):
                 echo = when + recorder.rng.randint(1, max(max_delay_ms, 1))
+                mangler.duplicated += 1
                 return [(when, node, event), (echo, node, event)]
             return (when, node, event)
 
+        mangler.duplicated = 0
         return mangler
 
     def crash_and_restart_after(self, delay_ms: int, node: int | None = None):

@@ -19,6 +19,7 @@ different (client, req_no) fails verification.
 from __future__ import annotations
 
 from ..crypto import ed25519_host as host
+from ..obsv import hooks
 from ..resilience import CircuitBreaker
 from .crypto_plane import DevicePlaneError
 
@@ -239,7 +240,10 @@ class SignaturePlane:
         self.flush_sizes.append(len(batch))
         start = time.perf_counter()
         verdicts = self._guarded_verify(batch)
-        self.flush_wall_s.append(time.perf_counter() - start)
+        wall = time.perf_counter() - start
+        self.flush_wall_s.append(wall)
+        if hooks.enabled:
+            hooks.record_flush("signature", "batch", len(batch), wall)
         for item, verdict in zip(batch, verdicts, strict=True):
             self._verdicts[self._key(*item)] = verdict
 
@@ -385,6 +389,8 @@ class AsyncSignaturePlane(SignaturePlane):
         self.flush_sizes.append(len(wave))
         self.overlapped_launches += 1
         self.device_verifies += len(wave)
+        if hooks.enabled:
+            hooks.record_flush("signature", "device", len(wave), launch_s)
 
     def valid(self, client_id: int, req_no: int, data: bytes) -> bool:
         key = self._key(client_id, req_no, data)
@@ -423,10 +429,16 @@ class AsyncSignaturePlane(SignaturePlane):
             for k, _row, _pk, _m, _s in wave:
                 del self._chunk_of[k]
             self._host_verify_wave(wave)
-            self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+            wall = launch_s + time.perf_counter() - start
+            self.flush_wall_s.append(wall)
+            if hooks.enabled:
+                hooks.record_flush("signature", "rescued", len(wave), wall)
             return self._verdicts[key]
         self.breaker.record_success()
-        self.flush_wall_s.append(launch_s + time.perf_counter() - start)
+        wall = launch_s + time.perf_counter() - start
+        self.flush_wall_s.append(wall)
+        if hooks.enabled:
+            hooks.record_flush("signature", "readback", len(wave), wall)
         verdicts = self._verdicts
         chunk_of = self._chunk_of
         for i, (k, _row, _pk, _m, _s) in enumerate(wave):
@@ -442,8 +454,11 @@ class AsyncSignaturePlane(SignaturePlane):
         start = time.perf_counter()
         for key, _row, pk, msg, sig in wave:
             self._verdicts[key] = host.verify(pk, msg, sig)
-        self.flush_wall_s.append(time.perf_counter() - start)
+        wall = time.perf_counter() - start
+        self.flush_wall_s.append(wall)
         self.host_verifies += len(wave)
+        if hooks.enabled:
+            hooks.record_flush("signature", "host", len(wave), wall)
 
     def _flush(self) -> None:
         """Host-verify the pending (sub-tile) wave synchronously."""

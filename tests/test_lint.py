@@ -44,3 +44,61 @@ def test_linter_catches_the_defect_classes(tmp_path):
     findings = lint.lint([bad])
     codes = {line.split()[1] for line in findings}
     assert codes == {"W1", "W2", "W3", "W4", "W5", "W6"}, findings
+
+
+def test_linter_forbids_wall_clock_in_monotonic_scope(tmp_path):
+    """W7: time.time() (either spelling) is banned in span/metric code
+    paths; it is scoped, so the same file outside the scope is clean."""
+    import lint
+
+    bad = tmp_path / "timed.py"
+    bad.write_text(
+        "import time\n"
+        "start = time.time()\n"
+        "elapsed = time.time() - start\n"
+    )
+    findings = lint.check_file(bad, monotonic_only=True)
+    assert len(findings) == 2
+    assert all("W7" in line for line in findings)
+    # Outside the monotonic scope (auto-detect: tmp_path is not in any
+    # MONOTONIC_ONLY_TREES fragment) the same file is clean.
+    assert lint.check_file(bad) == []
+
+    sneaky = tmp_path / "sneaky.py"
+    sneaky.write_text("from time import time\nx = time()\n")
+    findings = lint.check_file(sneaky, monotonic_only=True)
+    assert any("W7" in line for line in findings)
+
+    clean = tmp_path / "clean.py"
+    clean.write_text(
+        "import time\nstart = time.perf_counter()\nnow = time.monotonic()\n"
+    )
+    assert lint.check_file(clean, monotonic_only=True) == []
+
+
+def test_monotonic_scope_covers_obsv_and_hot_paths():
+    import lint
+
+    assert lint._in_monotonic_scope(
+        REPO / "mirbft_tpu" / "obsv" / "trace.py"
+    )
+    assert lint._in_monotonic_scope(
+        REPO / "mirbft_tpu" / "runtime" / "storage.py"
+    )
+    assert lint._in_monotonic_scope(
+        REPO / "mirbft_tpu" / "testengine" / "crypto_plane.py"
+    )
+    # eventlog run-metadata timestamps legitimately use the wall clock.
+    assert not lint._in_monotonic_scope(
+        REPO / "mirbft_tpu" / "testengine" / "eventlog.py"
+    )
+
+
+def test_every_cataloged_metric_is_documented():
+    """docs/OBSERVABILITY.md is the human-facing metric catalog; a metric
+    registered in code but absent from the docs cannot ship."""
+    from mirbft_tpu.obsv.metrics import CATALOG
+
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    missing = [name for name in CATALOG if name not in doc]
+    assert not missing, f"undocumented metrics: {missing}"

@@ -1,0 +1,357 @@
+"""Observability subsystem (mirbft_tpu/obsv): registry semantics, the
+Prometheus/JSON expositions, Chrome trace validity, the consensus
+timeline profiler on a seeded run, and the chaos-metrics integration.
+
+Every test that enables the process-global hooks disables them in a
+``finally`` — a leaked enabled state would silently instrument (and
+slow) every later test in the session.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mirbft_tpu.obsv import hooks
+from mirbft_tpu.obsv.metrics import (
+    NULL_COUNTER,
+    NULL_GAUGE,
+    NULL_HISTOGRAM,
+    Registry,
+    null_registry,
+)
+from mirbft_tpu.obsv.timeline import PHASES, TimelineProfiler
+from mirbft_tpu.obsv.trace import Tracer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    reg = Registry(strict=False)
+    c = reg.counter("c_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("c_total") is c  # same series, same handle
+
+    g = reg.gauge("g")
+    g.set(2.5)
+    assert g.value == 2.5
+
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 50.0):
+        h.observe(v)
+    assert h.count == 3
+    assert h.sum == pytest.approx(50.55)
+    # 0.05 <= 0.1; 0.5 <= 1.0; 50 lands only in +Inf (count/sum).
+    assert h.bucket_counts == [1, 1]
+
+
+def test_labels_key_distinct_series():
+    reg = Registry(strict=False)
+    a = reg.counter("x_total", path="device")
+    b = reg.counter("x_total", path="host")
+    a.inc(3)
+    b.inc(1)
+    assert a is not b
+    # kwarg order must not matter for series identity.
+    assert reg.counter("x_total", path="device") is a
+    snap = reg.snapshot()["x_total"]
+    assert snap["kind"] == "counter"
+    values = {
+        s["labels"]["path"]: s["value"] for s in snap["series"]
+    }
+    assert values == {"device": 3, "host": 1}
+
+
+def test_strict_registry_rejects_uncataloged_names():
+    reg = Registry()  # strict by default
+    with pytest.raises(KeyError):
+        reg.counter("mirbft_not_a_real_metric_total")
+    # Catalog names pass.
+    reg.counter("mirbft_wal_appends_total").inc()
+
+
+def test_kind_mismatch_raises():
+    reg = Registry(strict=False)
+    reg.counter("m")
+    with pytest.raises(TypeError):
+        reg.gauge("m")
+
+
+def test_null_registry_is_shared_noop_singletons():
+    reg = null_registry()
+    assert reg is null_registry()
+    assert reg.counter("anything", a="b") is NULL_COUNTER
+    assert reg.gauge("anything") is NULL_GAUGE
+    assert reg.histogram("anything") is NULL_HISTOGRAM
+    # No-ops: nothing accumulates, nothing raises.
+    NULL_COUNTER.inc(10)
+    NULL_GAUGE.set(3)
+    NULL_HISTOGRAM.observe(1.0)
+    assert NULL_COUNTER.value == 0
+    assert reg.snapshot() == {}
+    assert reg.prometheus_text() == ""
+
+
+def test_prometheus_exposition_format():
+    reg = Registry(strict=False)
+    reg.counter("req_total", path="device").inc(7)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    assert "# TYPE req_total counter" in lines
+    assert 'req_total{path="device"} 7' in lines
+    assert "# TYPE lat_seconds histogram" in lines
+    # Buckets are cumulative and +Inf equals the count.
+    assert 'lat_seconds_bucket{le="0.1"} 1' in lines
+    assert 'lat_seconds_bucket{le="1.0"} 2' in lines
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in lines
+    assert "lat_seconds_count 3" in lines
+    assert any(line.startswith("lat_seconds_sum ") for line in lines)
+    assert text.endswith("\n")
+
+
+def test_json_dump_round_trips():
+    reg = Registry(strict=False)
+    reg.gauge("g", scenario="a b\"c").set(1)
+    parsed = json.loads(reg.to_json())
+    assert parsed["g"]["series"][0]["labels"] == {"scenario": 'a b"c'}
+
+
+# ---------------------------------------------------------------------------
+# Tracer / Chrome trace validity
+# ---------------------------------------------------------------------------
+
+
+def _assert_well_nested(events):
+    """Per tid, any two X spans either nest or are disjoint."""
+    by_tid = {}
+    for e in events:
+        if e["ph"] == "X":
+            by_tid.setdefault(e["tid"], []).append(
+                (e["ts"], e["ts"] + e["dur"])
+            )
+    for spans in by_tid.values():
+        for i, (s1, e1) in enumerate(spans):
+            for s2, e2 in spans[i + 1 :]:
+                overlap = max(s1, s2) < min(e1, e2)
+                contained = (s1 <= s2 and e2 <= e1) or (
+                    s2 <= s1 and e1 <= e2
+                )
+                assert not overlap or contained, (spans,)
+
+
+def test_chrome_trace_is_valid_and_nested(tmp_path):
+    tracer = Tracer()
+    tracer.name_thread(0, "node 0")
+    with tracer.span("outer", cat="t", tid=0):
+        with tracer.span("inner", cat="t", tid=0):
+            pass
+        tracer.instant("mark", cat="consensus", tid=0, args={"seq": 1})
+    with tracer.span("later", cat="t", tid=0):
+        pass
+
+    out = tmp_path / "trace.json"
+    tracer.write(str(out))
+    trace = json.loads(out.read_text())
+    events = trace["traceEvents"]
+
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "node 0"
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner", "later"}
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    inst = [e for e in events if e["ph"] == "i"]
+    assert inst[0]["s"] == "t" and inst[0]["args"]["seq"] == 1
+    # Monotonic source: 'later' starts after 'outer' ends.
+    by_name = {e["name"]: e for e in xs}
+    assert (
+        by_name["later"]["ts"]
+        >= by_name["outer"]["ts"] + by_name["outer"]["dur"]
+    )
+    _assert_well_nested(events)
+
+
+def test_complete_records_backdated_span():
+    tracer = Tracer()
+    tracer._t0_ns -= 300_000_000  # pretend 300ms of tracer lifetime
+    tracer.complete("flush", cat="crypto", tid=-1, dur_s=0.25)
+    (e,) = tracer.events
+    assert e["ph"] == "X"
+    assert e["dur"] == pytest.approx(250_000, rel=0.01)  # µs
+    assert e["ts"] >= 0
+
+
+def test_complete_clamps_to_tracer_birth():
+    tracer = Tracer()
+    # A duration longer than the tracer has been alive must not produce
+    # a negative ts (invalid Chrome trace); it is clamped to birth.
+    tracer.complete("early", dur_s=10.0)
+    (e,) = tracer.events
+    assert e["ts"] >= 0
+    assert e["dur"] < 10.0 * 1e6
+
+
+# ---------------------------------------------------------------------------
+# Timeline profiler
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_profiler_synthetic_edges():
+    def inst(name, node, seq, t):
+        return {
+            "ph": "i",
+            "name": name,
+            "args": {"node": node, "seq": seq, "sim_ms": t},
+        }
+
+    events = [
+        inst("seq.allocated", 0, 1, 0),
+        inst("seq.preprepared", 0, 1, 10),
+        inst("seq.prepared", 0, 1, 40),
+        inst("seq.commit_quorum", 0, 1, 70),
+        inst("ckpt.stable", 0, 20, 500),
+        # Second node: no checkpoint, partial lifecycle.
+        inst("seq.allocated", 1, 1, 5),
+        inst("seq.preprepared", 1, 1, 25),
+    ]
+    prof = TimelineProfiler.from_events(events)
+    stats = {s.phase: s for s in prof.stats()}
+    assert stats["preprepare"].count == 2
+    assert sorted(prof.phase_samples()["preprepare"]) == [10, 20]
+    assert stats["prepare"].p50 == 30
+    assert stats["commit"].p50 == 30
+    assert stats["checkpoint"].count == 1
+    assert stats["checkpoint"].p50 == 430  # 500 - 70
+
+
+def test_timeline_profiler_on_seeded_run():
+    from mirbft_tpu.testengine.engine import BasicRecorder
+
+    metrics, tracer = hooks.enable(trace=True)
+    try:
+        rec = BasicRecorder(4, 4, 30, batch_size=2, seed=0, record=False)
+        rec.drain_clients(max_steps=2_000_000)
+    finally:
+        hooks.disable()
+
+    prof = TimelineProfiler.from_tracer(tracer)
+    stats = {s.phase: s for s in prof.stats()}
+    # 4 clients x 30 reqs / batch 2 = 60 seqs = 3 checkpoint windows
+    # (ci=20): enough that stable checkpoints must circulate, so every
+    # phase — including checkpoint — collects samples.
+    assert set(stats) == set(PHASES)
+    for s in stats.values():
+        assert s.count > 0
+        assert 0 <= s.p50 <= s.p95 <= s.p99
+    # The instrumented state machine fed the registry too.
+    snap = metrics.snapshot()
+    assert snap["mirbft_sm_events_total"]["series"]
+    assert snap["mirbft_sm_apply_seconds"]["series"][0]["count"] > 0
+    # And the trace round-trips through the Chrome JSON shape.
+    prof2 = TimelineProfiler.from_chrome_trace(tracer.chrome_trace())
+    assert {s.phase: s.count for s in prof2.stats()} == {
+        s.phase: s.count for s in prof.stats()
+    }
+
+
+def test_disabled_hooks_leave_no_trace():
+    from mirbft_tpu.testengine.engine import BasicRecorder
+
+    assert not hooks.enabled
+    rec = BasicRecorder(4, 2, 4, batch_size=2, seed=0, record=False)
+    rec.drain_clients(max_steps=500_000)
+    assert hooks.metrics is None and hooks.tracer is None
+
+
+# ---------------------------------------------------------------------------
+# Status fold + chaos integration
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_status_fold():
+    from mirbft_tpu.status import metrics_status
+
+    assert metrics_status().enabled is False
+    reg = Registry(strict=False)
+    reg.counter("mirbft_demo_total").inc(2)
+    status = metrics_status(reg)
+    assert status.enabled
+    assert "mirbft_demo_total" in status.pretty()
+    assert json.loads(status.to_json())["enabled"] is True
+
+
+def test_chaos_recovery_metric_matches_report():
+    from mirbft_tpu.chaos.runner import run_scenario
+    from mirbft_tpu.chaos.scenarios import smoke_matrix
+
+    scenario = smoke_matrix()[0]  # partition-minority
+    reg = Registry()
+    result = run_scenario(scenario, seed=0, registry=reg)
+    assert result.passed, result.violation
+    gauge = reg.gauge("mirbft_chaos_recovery_ms", scenario=scenario.name)
+    assert gauge.value == result.counters["recovery_ms"]
+    assert 0 < gauge.value <= scenario.recovery_bound_ms
+    dropped = reg.counter(
+        "mirbft_chaos_dropped_total", scenario=scenario.name
+    )
+    assert dropped.value == result.counters["partition_drops"] > 0
+
+
+def test_mangler_drop_and_duplicate_counters():
+    from mirbft_tpu.testengine.engine import BasicRecorder
+    from mirbft_tpu.testengine.manglers import is_step, percent, rule
+
+    dropper = rule(is_step(), percent(20)).drop()
+    doubler = rule(is_step(), percent(20)).duplicate(100)
+    rec = BasicRecorder(
+        4, 2, 4, batch_size=2, seed=3, record=False,
+        manglers=[dropper, doubler],
+    )
+    rec.drain_clients(max_steps=500_000)
+    assert dropper.dropped > 0
+    assert doubler.duplicated > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_smoke_writes_trace(tmp_path):
+    out = tmp_path / "trace.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "mirbft_tpu.obsv",
+            "--nodes",
+            "4",
+            "--clients",
+            "2",
+            "--reqs",
+            "6",
+            "--trace",
+            str(out),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "phase" in proc.stdout and "p99_ms" in proc.stdout
+    trace = json.loads(out.read_text())
+    assert any(e["ph"] == "i" for e in trace["traceEvents"])

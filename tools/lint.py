@@ -11,6 +11,13 @@ enforces the defect classes that have actually bitten BFT codebases:
 - W4 ``is``/``is not`` against str/int literals (identity vs equality)
 - W5 mutable default argument  (shared-state bug factory)
 - W6 f-string with no placeholders (usually a forgotten interpolation)
+- W7 wall-clock ``time.time()`` in monotonic-only code (instrumented /
+  latency-measuring paths must use ``time.perf_counter`` — the wall
+  clock steps under NTP and breaks span nesting and histograms).  W7 is
+  *scoped*: it applies only to files under the trees named in
+  ``MONOTONIC_ONLY_TREES`` (or when forced via the ``monotonic_only``
+  parameter); eventlog timestamps, for example, legitimately want the
+  wall clock.
 
 Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
 Also enforced in CI-equivalent form by ``tests/test_lint.py``.
@@ -74,7 +81,29 @@ def _string_uses(tree: ast.Module) -> set[str]:
     return out
 
 
-def check_file(path: Path) -> list[str]:
+# Path fragments whose files must never read the wall clock: span/metric
+# durations and simulated-time code.  testengine/eventlog.py (run metadata
+# timestamps) and bench/test files are deliberately outside the scope.
+MONOTONIC_ONLY_TREES = (
+    "mirbft_tpu/obsv/",
+    "mirbft_tpu/core/",
+    "mirbft_tpu/runtime/",
+    "mirbft_tpu/chaos/",
+    "mirbft_tpu/testengine/crypto_plane.py",
+    "mirbft_tpu/testengine/signing.py",
+)
+
+
+def _in_monotonic_scope(path: Path) -> bool:
+    posix = path.resolve().as_posix()
+    return any(fragment in posix for fragment in MONOTONIC_ONLY_TREES)
+
+
+def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
+    """Lint one file.  ``monotonic_only`` forces the W7 wall-clock check
+    on (True) or off (False); None scopes it by MONOTONIC_ONLY_TREES."""
+    if monotonic_only is None:
+        monotonic_only = _in_monotonic_scope(path)
     src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
@@ -135,6 +164,23 @@ def check_file(path: Path) -> list[str]:
                 findings.append(
                     f"{path}:{node.lineno}: W6 f-string without placeholders"
                 )
+        if monotonic_only:
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "time"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "time"
+            ):
+                findings.append(
+                    f"{path}:{node.lineno}: W7 wall-clock time.time() in "
+                    "monotonic-only code (use time.perf_counter)"
+                )
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                if any(alias.name == "time" for alias in node.names):
+                    findings.append(
+                        f"{path}:{node.lineno}: W7 'from time import time' in "
+                        "monotonic-only code (use time.perf_counter)"
+                    )
 
     return findings
 
