@@ -160,7 +160,7 @@ def ladder_run(hash_plane=None):
     wall = time.perf_counter() - start
     chains = {rec.node_states[n].app_chain for n in range(NODES)}
     assert len(chains) == 1, "nodes diverged!"
-    return wall, events, chains.pop()
+    return wall, events, chains.pop(), rec.now
 
 
 def warm_kernel_shapes(plane):
@@ -311,7 +311,7 @@ def rung3_run():
         "rung3_device_verifies": plane.device_verifies,
         "rung3_host_verifies": plane.host_verifies,
     }
-    return total / wall, p99_ms, events, sum(plane.flush_sizes), stats
+    return total / wall, p99_ms, events, sum(plane.flush_sizes), stats, rec.now
 
 
 RUNG4_NODES = 128
@@ -387,7 +387,7 @@ def rung4_run():
     (seq, value), (signers, asig) = sorted(certificates.items())[0]
     assert CheckpointCertPlane.verify(seq, value, signers, asig)
     assert not CheckpointCertPlane.verify(seq, value + b"!", signers, asig)
-    return total / wall, rec.event_count, len(certificates), agg_ms
+    return total / wall, rec.event_count, len(certificates), agg_ms, rec.now
 
 
 RUNG5_NODES = 256
@@ -450,7 +450,7 @@ def rung5_run():
     assert all(
         rec.committed_at(n) == total for n in range(RUNG5_NODES)
     ), "rung-5 missing commits"
-    return total / wall, events
+    return total / wall, events, rec.now
 
 
 class StageRunner:
@@ -462,14 +462,19 @@ class StageRunner:
     and every subsequent stage is ``skipped`` because the budget is gone.
     Per-stage wall time is recorded as a ``mirbft_bench_stage_seconds``
     gauge, which the final payload reads back — the registry is the
-    single source of truth for the timings."""
+    single source of truth for the timings.
+
+    ``stage_budget_s`` (env ``BENCH_STAGE_BUDGET_S``) additionally caps
+    each individual stage, so one pathological stage times out on its
+    own sub-budget instead of eating every later stage's runway."""
 
     # Don't bother starting a stage with less runway than this.
     MIN_RUNWAY_S = 5.0
 
-    def __init__(self, budget_s: float, registry):
+    def __init__(self, budget_s: float, registry, stage_budget_s=None):
         self.deadline = time.monotonic() + budget_s
         self.registry = registry
+        self.stage_budget_s = stage_budget_s
         self.status: dict = {}  # stage -> {"status": ..., ["detail": ...]}
 
     def remaining(self) -> float:
@@ -488,6 +493,8 @@ class StageRunner:
         if runway < self.MIN_RUNWAY_S:
             entry["detail"] = "budget exhausted"
             return None
+        if self.stage_budget_s is not None:
+            runway = min(runway, self.stage_budget_s)
         box: dict = {}
 
         def work():
@@ -533,12 +540,43 @@ def _round(value, digits=1):
     return None if value is None else round(value, digits)
 
 
+def _fold_engine(registry, stage, events, sim_ms):
+    """Record one engine-driving stage's Recorder outcome as
+    ``mirbft_engine_*`` gauges/counters labeled by stage; the payload's
+    ``engine_gauges`` key is read back from the registry snapshot so the
+    diff gate sees the same numbers a scrape would."""
+    if events is not None:
+        registry.counter("mirbft_engine_events_total", stage=stage).inc(events)
+    if sim_ms is not None:
+        registry.gauge("mirbft_engine_sim_ms", stage=stage).set(sim_ms)
+
+
+def _engine_gauges(registry) -> dict:
+    """{stage: {events, sim_ms}} from the registry snapshot."""
+    snap = registry.snapshot()
+    out: dict = {}
+    for metric, key in (
+        ("mirbft_engine_events_total", "events"),
+        ("mirbft_engine_sim_ms", "sim_ms"),
+    ):
+        for series in snap.get(metric, {}).get("series", []):
+            stage = series["labels"].get("stage")
+            if stage is not None:
+                out.setdefault(stage, {})[key] = series["value"]
+    return out
+
+
 def main() -> int:
     budget_s = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+    stage_budget = os.environ.get("BENCH_STAGE_BUDGET_S")
     from mirbft_tpu.obsv.metrics import Registry
 
     registry = Registry()
-    runner = StageRunner(budget_s, registry)
+    runner = StageRunner(
+        budget_s,
+        registry,
+        stage_budget_s=float(stage_budget) if stage_budget else None,
+    )
 
     def warm_calibrate():
         _enable_compile_cache()
@@ -565,11 +603,15 @@ def main() -> int:
         enabled=plane is not None,
         detail="needs warm_calibrate",
     )
-    tpu_wall, events, chain = ladder if ladder is not None else (None,) * 3
-    host = runner.run("ladder_host", ladder_run)
-    host_wall, host_events, host_chain = (
-        host if host is not None else (None,) * 3
+    tpu_wall, events, chain, ladder_sim = (
+        ladder if ladder is not None else (None,) * 4
     )
+    _fold_engine(registry, "ladder_kernel", events, ladder_sim)
+    host = runner.run("ladder_host", ladder_run)
+    host_wall, host_events, host_chain, host_sim = (
+        host if host is not None else (None,) * 4
+    )
+    _fold_engine(registry, "ladder_host", host_events, host_sim)
     # Bit-exactness gate: the kernel run must replay the host run exactly
     # (same event count, same app chain).  Only checkable when both ran.
     consistent = None
@@ -591,15 +633,20 @@ def main() -> int:
         enabled=ed is not None,
         detail="needs ed25519_microbench",
     )
-    rung3_rate, rung3_p99, rung3_events, rung3_verified, rung3_stats = (
-        r3 if r3 is not None else (None, None, None, None, {})
+    rung3_rate, rung3_p99, rung3_events, rung3_verified, rung3_stats, r3_sim = (
+        r3 if r3 is not None else (None, None, None, None, {}, None)
     )
+    _fold_engine(registry, "rung3", rung3_events, r3_sim)
     r4 = runner.run("rung4", rung4_run)
-    rung4_rate, rung4_events, rung4_certs, rung4_agg_ms = (
-        r4 if r4 is not None else (None,) * 4
+    rung4_rate, rung4_events, rung4_certs, rung4_agg_ms, r4_sim = (
+        r4 if r4 is not None else (None,) * 5
     )
+    _fold_engine(registry, "rung4", rung4_events, r4_sim)
     r5 = runner.run("rung5", rung5_run)
-    rung5_rate, rung5_events = r5 if r5 is not None else (None, None)
+    rung5_rate, rung5_events, r5_sim = (
+        r5 if r5 is not None else (None, None, None)
+    )
+    _fold_engine(registry, "rung5", rung5_events, r5_sim)
 
     total_reqs = CLIENTS * REQS_PER_CLIENT
     committed_rate = total_reqs / tpu_wall if tpu_wall else None
@@ -680,7 +727,9 @@ def main() -> int:
         ),
         "rung5_engine_events": rung5_events,
         "bench_budget_s": budget_s,
+        "bench_stage_budget_s": runner.stage_budget_s,
         "stages": runner.stage_report(),
+        "engine_gauges": _engine_gauges(registry),
     }
     if plane is not None:
         payload.update(

@@ -28,7 +28,7 @@ from .. import pb
 from ..obsv import hooks
 from .actions import Actions
 from .persisted import Persisted
-from .quorum import intersection_quorum
+from .quorum import intersection_quorum, seq_to_bucket
 
 
 class SeqState(enum.IntEnum):
@@ -130,7 +130,13 @@ class Sequence:
         self.batch = request_acks
         self.outstanding_reqs = outstanding_reqs
         if hooks.enabled:
-            hooks.milestone("seq.allocated", self.my_config.id, self.seq_no)
+            hooks.milestone(
+                "seq.allocated",
+                self.my_config.id,
+                self.seq_no,
+                epoch=self.epoch,
+                bucket=seq_to_bucket(self.seq_no, self.network_config),
+            )
 
         if not request_acks:
             # Null batch: nothing to digest.
@@ -180,7 +186,13 @@ class Sequence:
         )
         self.state = SeqState.PREPREPARED
         if hooks.enabled:
-            hooks.milestone("seq.preprepared", self.my_config.id, self.seq_no)
+            hooks.milestone(
+                "seq.preprepared",
+                self.my_config.id,
+                self.seq_no,
+                epoch=self.epoch,
+                bucket=seq_to_bucket(self.seq_no, self.network_config),
+            )
 
         actions = Actions()
         if self.owner == self.my_config.id:
@@ -247,7 +259,13 @@ class Sequence:
 
         self.state = SeqState.PREPARED
         if hooks.enabled:
-            hooks.milestone("seq.prepared", self.my_config.id, self.seq_no)
+            hooks.milestone(
+                "seq.prepared",
+                self.my_config.id,
+                self.seq_no,
+                epoch=self.epoch,
+                bucket=seq_to_bucket(self.seq_no, self.network_config),
+            )
 
         actions = Actions().send(
             self.network_config.nodes,
@@ -289,5 +307,9 @@ class Sequence:
         self.state = SeqState.COMMITTED
         if hooks.enabled:
             hooks.milestone(
-                "seq.commit_quorum", self.my_config.id, self.seq_no
+                "seq.commit_quorum",
+                self.my_config.id,
+                self.seq_no,
+                epoch=self.epoch,
+                bucket=seq_to_bucket(self.seq_no, self.network_config),
             )

@@ -1,4 +1,5 @@
-"""Observability plane: metrics registry, trace spans, timeline profiler.
+"""Observability plane: metrics registry, trace spans, timeline profiler,
+cross-node flow merging, HTTP exposition, and a timeline-diff gate.
 
 Usage:
 
@@ -13,31 +14,51 @@ Instrumented call sites across core/testengine/runtime/chaos guard on
 ``hooks.enabled`` so that with observability off the entire plane costs
 one branch per boundary crossing.  ``python -m mirbft_tpu.obsv`` runs an
 instrumented testengine ladder and prints the per-phase consensus
-latency table.
+latency table; ``--merge`` combines per-node traces and ``--diff`` gates
+one artifact against another.
 """
 
 from __future__ import annotations
 
 from . import hooks
+from .diff import diff_files, diff_series, extract_series
+from .exporter import ObsvExporter
+from .merge import merge_files, merge_traces, split_node_traces
 from .metrics import (
+    CARDINALITY,
     CATALOG,
+    CATALOG_LABELS,
     DEFAULT_BUCKETS,
+    DEFAULT_CARDINALITY,
+    CardinalityError,
     NullRegistry,
     Registry,
     null_registry,
 )
 from .timeline import PHASES, PhaseStats, TimelineProfiler
-from .trace import Tracer
+from .trace import SpanSampler, Tracer
 
 __all__ = [
+    "CARDINALITY",
     "CATALOG",
+    "CATALOG_LABELS",
+    "CardinalityError",
     "DEFAULT_BUCKETS",
+    "DEFAULT_CARDINALITY",
     "NullRegistry",
+    "ObsvExporter",
     "PHASES",
     "PhaseStats",
     "Registry",
+    "SpanSampler",
     "TimelineProfiler",
     "Tracer",
+    "diff_files",
+    "diff_series",
+    "extract_series",
     "hooks",
+    "merge_files",
+    "merge_traces",
     "null_registry",
+    "split_node_traces",
 ]

@@ -1,14 +1,26 @@
-"""``python -m mirbft_tpu.obsv`` — instrumented testengine ladder.
+"""``python -m mirbft_tpu.obsv`` — instrumented ladder, merge, and diff.
 
-Runs a seeded Recorder with the observability plane enabled, prints the
-per-phase consensus latency table (p50/p95/p99), and optionally writes a
-Chrome trace-event file (``--trace``, open in ui.perfetto.dev), the
-Prometheus exposition (``--prom``), or the registry JSON (``--json``).
+Default mode runs a seeded Recorder with the observability plane
+enabled, prints the per-phase consensus latency table (p50/p95/p99), and
+optionally writes a Chrome trace-event file (``--trace``, open in
+ui.perfetto.dev), N per-node trace files plus their merge
+(``--trace-dir``), the Prometheus exposition (``--prom``), or the
+registry JSON (``--json``).
+
+Tool modes (mutually exclusive with the run):
+
+- ``--merge OUT IN [IN ...]`` — merge per-node traces into one
+  Perfetto-loadable file with per-node process lanes (obsv/merge.py).
+- ``--diff A B [--threshold PCT]`` — compare two trace/bench artifacts;
+  prints a human summary plus one machine-readable JSON line, exits
+  nonzero on a >= threshold regression (obsv/diff.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 
 from . import hooks
@@ -19,7 +31,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mirbft_tpu.obsv",
         description="Run an instrumented testengine ladder and report "
-        "per-phase consensus latency.",
+        "per-phase consensus latency; or merge/diff trace artifacts.",
     )
     parser.add_argument("--nodes", type=int, default=4)
     parser.add_argument("--clients", type=int, default=4)
@@ -30,18 +42,67 @@ def main(argv=None) -> int:
                         help="requests per client")
     parser.add_argument("--batch-size", type=int, default=2)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--sample-rate", type=float, default=None,
+                        help="deterministic span sampling rate in (0,1]; "
+                        "milestones/flows always kept")
     parser.add_argument("--trace", metavar="PATH",
                         help="write a Chrome trace-event JSON file")
+    parser.add_argument("--trace-dir", metavar="DIR",
+                        help="write per-node trace files plus merged.json")
     parser.add_argument("--prom", action="store_true",
                         help="print Prometheus text exposition")
     parser.add_argument("--json", action="store_true",
                         help="print the registry snapshot as JSON")
+    parser.add_argument("--merge", nargs="+", metavar="PATH",
+                        help="merge mode: OUT IN [IN ...]")
+    parser.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                        help="diff mode: compare two trace/bench artifacts")
+    parser.add_argument("--threshold", type=float, default=None,
+                        help="regression threshold percent for --diff")
     args = parser.parse_args(argv)
 
+    if args.diff:
+        return _diff_main(args)
+    if args.merge:
+        return _merge_main(args)
+    return _run_main(args)
+
+
+def _diff_main(args) -> int:
+    from .diff import DEFAULT_THRESHOLD_PCT, diff_files, render_report
+
+    threshold = (
+        args.threshold if args.threshold is not None else DEFAULT_THRESHOLD_PCT
+    )
+    report = diff_files(args.diff[0], args.diff[1], threshold_pct=threshold)
+    print(render_report(report))
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+def _merge_main(args) -> int:
+    from .merge import merge_files
+
+    if len(args.merge) < 3:
+        print("--merge needs OUT and at least two inputs", file=sys.stderr)
+        return 2
+    out, inputs = args.merge[0], args.merge[1:]
+    merged = merge_files(inputs, out_path=out)
+    print(
+        f"merged {len(inputs)} traces "
+        f"({len(merged['traceEvents'])} events) into {out}"
+    )
+    return 0
+
+
+def _run_main(args) -> int:
     # Import after argparse so --help stays instant.
     from ..testengine.engine import BasicRecorder
+    from .merge import merge_traces, split_node_traces
 
-    registry, tracer = hooks.enable(trace=True)
+    registry, tracer = hooks.enable(
+        trace=True, sample_rate=args.sample_rate, sample_seed=args.seed
+    )
     try:
         rec = BasicRecorder(
             args.nodes,
@@ -71,6 +132,20 @@ def main(argv=None) -> int:
             tracer.write(args.trace)
             print(f"\ntrace written to {args.trace} "
                   "(open in ui.perfetto.dev)")
+        if args.trace_dir:
+            os.makedirs(args.trace_dir, exist_ok=True)
+            per_node = split_node_traces(tracer, range(args.nodes))
+            paths = []
+            for node, trace in per_node.items():
+                path = os.path.join(args.trace_dir, f"node{node}.trace.json")
+                with open(path, "w", encoding="utf-8") as f:
+                    json.dump(trace, f)
+                paths.append(path)
+            merged_path = os.path.join(args.trace_dir, "merged.trace.json")
+            with open(merged_path, "w", encoding="utf-8") as f:
+                json.dump(merge_traces(per_node.values()), f)
+            print(f"\nper-node traces: {', '.join(paths)}")
+            print(f"merged trace:    {merged_path} (open in ui.perfetto.dev)")
         if args.prom:
             print()
             print(registry.prometheus_text(), end="")

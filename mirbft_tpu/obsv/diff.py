@@ -1,0 +1,158 @@
+"""Timeline-diff regression gate: compare two trace/bench artifacts.
+
+``python -m mirbft_tpu.obsv --diff A B [--threshold PCT]`` loads two
+artifacts, extracts a flat ``{series_name: value}`` mapping from each,
+and reports per-series deltas with a machine-readable verdict.  Exit
+status is the gate: nonzero iff any gated series regressed by at least
+the threshold (so CI can chain BENCH_r*.json artifacts rung-to-rung).
+
+Supported artifact shapes (auto-detected):
+
+- **Chrome trace JSON** (``traceEvents`` key): fed through the
+  consensus TimelineProfiler; series are
+  ``phase.<name>.{p50,p95,p99}_ms`` plus ``phase.<name>.count``.
+- **bench.py JSON** (``metric``/``stages`` keys): numeric top-level
+  fields (rates, p99s, walls), per-stage ``seconds`` from ``stages``,
+  and per-stage engine gauges from ``engine_gauges``.
+
+Direction is inferred per series name: throughput-like series
+(``per_sec``, ``rate``, ``count``, ``events``) regress when they *drop*;
+latency-like series (``p50/p95/p99``, ``ms``, ``seconds``, ``wall``)
+regress when they *rise*; anything else is reported but never gates.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .timeline import TimelineProfiler
+
+DEFAULT_THRESHOLD_PCT = 10.0
+
+_HIGHER_BETTER = ("per_sec", "rate", "count", "events", "reqs", "verified")
+_LOWER_BETTER = ("p50", "p95", "p99", "_ms", "ms_", "seconds", "wall", "sim_ms")
+
+
+def direction(name):
+    """'higher', 'lower', or None (informational only)."""
+    lowered = name.lower()
+    if any(tok in lowered for tok in _HIGHER_BETTER):
+        return "higher"
+    if any(tok in lowered for tok in _LOWER_BETTER):
+        return "lower"
+    return None
+
+
+def extract_series(artifact):
+    """Flatten one parsed artifact into ``{series_name: float}``."""
+    if "traceEvents" in artifact:
+        profiler = TimelineProfiler.from_chrome_trace(artifact)
+        series = {}
+        for stats in profiler.stats():
+            series[f"phase.{stats.phase}.count"] = float(stats.count)
+            series[f"phase.{stats.phase}.p50_ms"] = stats.p50
+            series[f"phase.{stats.phase}.p95_ms"] = stats.p95
+            series[f"phase.{stats.phase}.p99_ms"] = stats.p99
+        return series
+    series = {}
+    for key, value in artifact.items():
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            series[key] = float(value)
+    for stage, info in (artifact.get("stages") or {}).items():
+        seconds = (info or {}).get("seconds")
+        if isinstance(seconds, (int, float)):
+            series[f"stage.{stage}.seconds"] = float(seconds)
+    for stage, gauges in (artifact.get("engine_gauges") or {}).items():
+        for gauge, value in (gauges or {}).items():
+            if isinstance(value, (int, float)):
+                series[f"engine.{stage}.{gauge}"] = float(value)
+    return series
+
+
+def diff_series(a, b, threshold_pct=DEFAULT_THRESHOLD_PCT):
+    """Compare two series maps; returns the verdict dict.
+
+    ``delta_pct`` is signed toward "worse": positive means B regressed
+    relative to A by that percentage, regardless of direction.
+    """
+    regressions = []
+    improvements = []
+    unchanged = []
+    informational = []
+    for name in sorted(set(a) & set(b)):
+        va, vb = a[name], b[name]
+        dirn = direction(name)
+        if va == vb:
+            unchanged.append(name)
+            continue
+        if va == 0:
+            # No baseline to take a percentage of; report, never gate.
+            informational.append({"series": name, "a": va, "b": vb})
+            continue
+        raw_pct = (vb - va) / abs(va) * 100.0
+        if dirn is None:
+            informational.append(
+                {"series": name, "a": va, "b": vb, "change_pct": raw_pct}
+            )
+            continue
+        worse_pct = raw_pct if dirn == "lower" else -raw_pct
+        entry = {
+            "series": name,
+            "a": va,
+            "b": vb,
+            "direction": dirn,
+            "delta_pct": worse_pct,
+        }
+        if worse_pct >= threshold_pct:
+            regressions.append(entry)
+        else:
+            improvements.append(entry)
+    return {
+        "threshold_pct": threshold_pct,
+        "ok": not regressions,
+        "regressions": regressions,
+        "improvements": improvements,
+        "unchanged": unchanged,
+        "informational": informational,
+        "only_a": sorted(set(a) - set(b)),
+        "only_b": sorted(set(b) - set(a)),
+    }
+
+
+def diff_files(path_a, path_b, threshold_pct=DEFAULT_THRESHOLD_PCT):
+    """Load, extract, and diff two artifact files."""
+    with open(path_a, "r", encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, "r", encoding="utf-8") as f:
+        b = json.load(f)
+    report = diff_series(
+        extract_series(a), extract_series(b), threshold_pct=threshold_pct
+    )
+    report["a"] = str(path_a)
+    report["b"] = str(path_b)
+    return report
+
+
+def render_report(report):
+    """Human-readable summary lines for the CLI."""
+    lines = [
+        f"diff {report.get('a', 'A')} -> {report.get('b', 'B')} "
+        f"(threshold {report['threshold_pct']:g}%)"
+    ]
+    for entry in report["regressions"]:
+        lines.append(
+            f"  REGRESSED {entry['series']}: {entry['a']:g} -> {entry['b']:g} "
+            f"({entry['delta_pct']:+.1f}% worse)"
+        )
+    for entry in report["improvements"]:
+        lines.append(
+            f"  ok        {entry['series']}: {entry['a']:g} -> {entry['b']:g} "
+            f"({entry['delta_pct']:+.1f}% worse)"
+        )
+    lines.append(
+        f"  unchanged: {len(report['unchanged'])}  "
+        f"informational: {len(report['informational'])}  "
+        f"only-in-one: {len(report['only_a']) + len(report['only_b'])}"
+    )
+    lines.append("VERDICT: " + ("ok" if report["ok"] else "REGRESSION"))
+    return "\n".join(lines)

@@ -12,6 +12,11 @@ restores the no-op state.  ``sim_now`` is the testengine's simulated
 clock in ms — the Recorder publishes it as it advances, so milestone
 instants carry simulated time alongside the monotonic wall timestamp.
 
+``sample_rate`` (set via ``enable(sample_rate=...)``) thins ph:"X" spans
+deterministically for long-running ladders; milestone instants and flow
+records are never sampled out, so the timeline profiler and merge.py
+always see the full consensus skeleton.
+
 Everything here is clock-free except through the tracer/registry, which
 use ``time.perf_counter``-family monotonic sources only (enforced by the
 W7 lint rule).
@@ -19,53 +24,90 @@ W7 lint rule).
 
 from __future__ import annotations
 
+from .metrics import CardinalityError
+
 enabled = False
 metrics = None  # Registry when enabled, else None
 tracer = None  # Tracer when tracing was requested, else None
 sim_now = None  # simulated ms (testengine runs), None under the runtime
+sample_rate = None  # span sampling rate in (0, 1], None = keep everything
 
 
-def enable(registry=None, trace=False):
+def enable(registry=None, trace=False, sample_rate=None, sample_seed=0):
     """Turn observability on.  Returns ``(metrics, tracer)``.
 
     ``registry`` defaults to a fresh Registry; ``trace=True`` also
     installs a fresh Tracer (span/instant capture is more expensive than
     counters, so it is opt-in even when metrics are on).
+    ``sample_rate`` keeps roughly that fraction of ph:"X" spans via a
+    deterministic seed-derived stride (see trace.SpanSampler); it never
+    touches milestones or flow events.
     """
     global enabled, metrics, tracer, sim_now
     from .metrics import Registry
-    from .trace import Tracer
+    from .trace import SpanSampler, Tracer
 
     metrics = registry if registry is not None else Registry()
-    tracer = Tracer() if trace else None
+    sampler = None
+    if sample_rate is not None and sample_rate < 1.0:
+        sampler = SpanSampler(sample_rate, seed=sample_seed)
+    tracer = Tracer(sampler=sampler) if trace else None
     sim_now = None
+    globals()["sample_rate"] = sample_rate
     enabled = True
     return metrics, tracer
 
 
 def disable():
     """Restore the no-op state (instrumentation sites become one branch)."""
-    global enabled, metrics, tracer, sim_now
+    global enabled, metrics, tracer, sim_now, sample_rate
     enabled = False
     metrics = None
     tracer = None
     sim_now = None
+    sample_rate = None
 
 
-def milestone(name, node, seq):
-    """Emit a protocol-milestone instant event (no-op without a tracer).
+def milestone(name, node, seq, epoch=None, bucket=None):
+    """Emit a protocol milestone: instant event + flow record + counter.
 
     Call sites still guard with ``if hooks.enabled:`` so the disabled
-    cost stays a single branch; this function only re-checks the tracer.
+    cost stays a single branch; this function only re-checks the tracer
+    and registry.
+
+    ``epoch``/``bucket`` mint the flow id ``"<epoch>.<seq>.<bucket>"``
+    when this is the first milestone for ``(node, seq)``; terminal sites
+    (``seq.committed``) may omit them — the tracer resolves the id from
+    its open-flow table.  Checkpoint milestones (``ckpt.*``) get their
+    own flow family ``"c.<seq>"`` of step records that merge.py promotes
+    to s/f across node lanes.
     """
+    args = {"node": node, "seq": seq, "sim_ms": sim_now}
+    if epoch is not None:
+        args["epoch"] = epoch
+    if bucket is not None:
+        args["bucket"] = bucket
     t = tracer
     if t is not None:
-        t.instant(
-            name,
-            cat="consensus",
-            tid=node,
-            args={"node": node, "seq": seq, "sim_ms": sim_now},
-        )
+        t.instant(name, cat="consensus", tid=node, args=args)
+        if name.startswith("ckpt."):
+            t.flow_step(name, tid=node, flow_id=f"c.{seq}")
+        else:
+            t.flow_milestone(name, tid=node, seq_no=seq, epoch=epoch, bucket=bucket)
+    m = metrics
+    if m is not None:
+        try:
+            if epoch is not None and bucket is not None:
+                m.counter(
+                    "mirbft_seq_milestones_total",
+                    milestone=name,
+                    epoch=str(epoch),
+                    bucket=str(bucket),
+                ).inc()
+            else:
+                m.counter("mirbft_seq_milestones_total", milestone=name).inc()
+        except CardinalityError:
+            pass  # over budget: keep the instant, drop the counter
 
 
 def record_flush(plane, path, items, seconds=None):

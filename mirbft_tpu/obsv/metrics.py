@@ -16,6 +16,14 @@ Design points:
 - **Label sets are kwargs.** ``registry.counter("x_total", path="device")``
   keys the series on the sorted label items, so the same call site always
   returns the same underlying series.
+- **Label names and cardinality are budgeted.** :data:`CATALOG_LABELS`
+  declares the only label names each metric may carry, and
+  :data:`CARDINALITY` caps how many label-set series a family may grow
+  (default :data:`DEFAULT_CARDINALITY`).  A strict registry *rejects*
+  registration beyond the documented bound with
+  :class:`CardinalityError` — callers on hot paths (hooks.milestone)
+  catch it and degrade to "instant recorded, counter skipped" rather
+  than let an epoch storm OOM the scrape path.
 - **Monotonic-only.** Nothing in this module reads a clock; durations are
   observed by callers from ``time.perf_counter`` deltas (W7 lint).
 """
@@ -40,6 +48,7 @@ CATALOG = {
     "mirbft_engine_sim_ms": "Final simulated clock of a testengine Recorder run.",
     "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
     "mirbft_reqstore_appends_total": "Request-store record appends.",
+    "mirbft_seq_milestones_total": "Consensus milestones reached, by milestone name, epoch, and bucket.",
     "mirbft_reqstore_fsync_seconds": "Wall time per request-store fsync.",
     "mirbft_reqstore_fsyncs_total": "Request-store fsync calls.",
     "mirbft_sm_actions_total": "Actions emitted by StateMachine.apply_event, by kind.",
@@ -51,6 +60,49 @@ CATALOG = {
     "mirbft_wal_fsync_seconds": "Wall time per WAL fsync.",
     "mirbft_wal_fsyncs_total": "WAL fsync calls.",
 }
+
+# name -> allowed label names.  A strict registry rejects any label key
+# outside this set, so a new dimension cannot ship undocumented (the
+# docs test checks every label name below against docs/OBSERVABILITY.md).
+CATALOG_LABELS = {
+    "mirbft_bench_stage_seconds": ("stage",),
+    "mirbft_chaos_dropped_total": ("scenario",),
+    "mirbft_chaos_duplicated_total": ("scenario",),
+    "mirbft_chaos_recovery_ms": ("scenario",),
+    "mirbft_crypto_flush_seconds": ("plane",),
+    "mirbft_crypto_flush_total": ("plane", "path"),
+    "mirbft_crypto_items_total": ("plane", "path"),
+    "mirbft_engine_events_total": ("stage",),
+    "mirbft_engine_sim_ms": ("stage",),
+    "mirbft_proc_phase_seconds": ("phase",),
+    "mirbft_reqstore_appends_total": (),
+    "mirbft_reqstore_fsync_seconds": (),
+    "mirbft_reqstore_fsyncs_total": (),
+    "mirbft_seq_milestones_total": ("milestone", "epoch", "bucket"),
+    "mirbft_sm_actions_total": ("kind",),
+    "mirbft_sm_apply_seconds": (),
+    "mirbft_sm_events_total": ("type",),
+    "mirbft_transport_frames_total": ("outcome",),
+    "mirbft_transport_reconnects_total": ("outcome",),
+    "mirbft_wal_appends_total": (),
+    "mirbft_wal_fsync_seconds": (),
+    "mirbft_wal_fsyncs_total": (),
+}
+
+# Per-family series budgets.  Most label spaces here are small and
+# closed (phases, outcomes, planes); DEFAULT_CARDINALITY covers them
+# with wide margin.  mirbft_seq_milestones_total is the one open-ended
+# family — milestone(6) x epoch x bucket — so it gets an explicit
+# larger bound.  Both numbers are part of the documented contract in
+# docs/OBSERVABILITY.md.
+DEFAULT_CARDINALITY = 256
+CARDINALITY = {
+    "mirbft_seq_milestones_total": 4096,
+}
+
+
+class CardinalityError(ValueError):
+    """A metric family tried to grow beyond its series budget."""
 
 # Latency buckets (seconds): 5us .. 5s, roughly geometric.  Chosen to
 # resolve both sub-ms host hashing and multi-second device round trips.
@@ -194,11 +246,20 @@ class Registry:
         self._kinds = {}
 
     def _get(self, name, labels, kind, factory):
-        if self._strict and name not in CATALOG:
-            raise KeyError(
-                f"metric {name!r} is not in obsv.metrics.CATALOG; "
-                "declare it (and document it in docs/OBSERVABILITY.md)"
-            )
+        if self._strict:
+            if name not in CATALOG:
+                raise KeyError(
+                    f"metric {name!r} is not in obsv.metrics.CATALOG; "
+                    "declare it (and document it in docs/OBSERVABILITY.md)"
+                )
+            allowed = CATALOG_LABELS.get(name, ())
+            for label in labels:
+                if label not in allowed:
+                    raise KeyError(
+                        f"label {label!r} is not declared for {name!r} in "
+                        "obsv.metrics.CATALOG_LABELS; declare it (and "
+                        "document it in docs/OBSERVABILITY.md)"
+                    )
         key = tuple(sorted(labels.items()))
         with self._lock:
             family = self._families.get(name)
@@ -211,6 +272,14 @@ class Registry:
                 )
             metric = family.get(key)
             if metric is None:
+                if self._strict:
+                    budget = CARDINALITY.get(name, DEFAULT_CARDINALITY)
+                    if len(family) >= budget:
+                        raise CardinalityError(
+                            f"metric {name!r} is at its cardinality budget "
+                            f"({budget} series); refusing to register "
+                            f"labels {dict(key)!r}"
+                        )
                 metric = family[key] = factory()
             return metric
 

@@ -13,8 +13,13 @@ Event vocabulary used here:
 - ``ph: "i"`` instant events with scope ``"t"`` (thread) — protocol
   milestones (``seq.preprepared`` etc.), carrying ``args`` including the
   simulated clock when the testengine is driving.
-- ``ph: "M"`` metadata — thread names, so Perfetto rows read "node 0"
-  instead of bare tids.
+- ``ph: "s"/"t"/"f"`` flow events — one flow per committed sequence,
+  id ``"<epoch>.<seq_no>.<bucket>"``, opened at ``seq.allocated``,
+  stepped at each intermediate milestone, finished at ``seq.committed``.
+  ``obsv/merge.py`` stitches these across per-node traces.
+- ``ph: "M"`` metadata — thread names plus an optional ``clock_sync``
+  record (monotonic anchor + peer offsets) that merge.py uses to align
+  traces from different processes.
 
 All timestamps come from ``time.perf_counter_ns`` relative to the
 tracer's birth — monotonic by construction (W7 lint forbids
@@ -25,6 +30,56 @@ from __future__ import annotations
 
 import json
 import time
+
+# Milestone names that participate in a sequence's flow.  Terminal
+# milestones close the flow (ph "f"); the first milestone seen for a
+# (tid, seq) opens it (ph "s"); anything in between is a step (ph "t").
+FLOW_TERMINAL = frozenset({"seq.committed"})
+
+#: Metadata record name carrying the tracer's monotonic anchor.
+CLOCK_SYNC = "clock_sync"
+
+
+class SpanSampler:
+    """Deterministic 1-in-k span sampling.
+
+    ``rate`` is the target fraction of spans to keep; the stride is
+    ``round(1/rate)``.  The phase within the stride is derived from
+    ``seed`` so two tracers with the same seed keep the same spans —
+    no wall clock, no ``random`` (W7-compatible).  Milestones and flow
+    records are never routed through the sampler.
+    """
+
+    __slots__ = ("rate", "stride", "_n")
+
+    def __init__(self, rate: float, seed: int = 0):
+        if not (0.0 < rate <= 1.0):
+            raise ValueError(f"sample rate must be in (0, 1], got {rate}")
+        self.rate = rate
+        self.stride = max(1, round(1.0 / rate))
+        self._n = seed % self.stride
+
+    def keep(self) -> bool:
+        k = self._n == 0
+        self._n += 1
+        if self._n >= self.stride:
+            self._n = 0
+        return k
+
+
+class _NullSpan:
+    """Stand-in for a sampled-out span; records nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
 
 
 class _Span:
@@ -64,10 +119,21 @@ class Tracer:
     the testengine is single-threaded anyway.
     """
 
-    def __init__(self):
+    def __init__(self, sampler: SpanSampler | None = None):
         self._t0_ns = time.perf_counter_ns()
         self.events = []
         self._thread_names = {}
+        self._sampler = sampler
+        # Open flows keyed by (tid, seq_no) -> flow id string.  The
+        # terminal milestone site (engine apply / runtime commit) does
+        # not know epoch/bucket, so it resolves the id here.
+        self._flows = {}
+        self._clock_sync = None
+
+    @property
+    def t0_ns(self) -> int:
+        """Monotonic birth anchor (perf_counter_ns at construction)."""
+        return self._t0_ns
 
     def _now_us(self):
         return (time.perf_counter_ns() - self._t0_ns) / 1000.0
@@ -77,8 +143,24 @@ class Tracer:
         if self._thread_names.get(tid) != name:
             self._thread_names[tid] = name
 
+    def set_clock_sync(self, node, offsets_ns=None):
+        """Attach a clock_sync metadata record to this trace.
+
+        ``node`` is this trace's node id; ``offsets_ns`` maps peer node
+        id -> (local monotonic - peer monotonic) in nanoseconds, as
+        estimated from the transport hello handshake.  merge.py uses the
+        reference node's offsets to shift peer lanes onto one timeline.
+        """
+        self._clock_sync = {
+            "node": node,
+            "t0_ns": self._t0_ns,
+            "offsets_ns": {str(k): int(v) for k, v in (offsets_ns or {}).items()},
+        }
+
     def span(self, name, cat="", tid=0, **args):
         """Context manager producing one ph:"X" complete event."""
+        if self._sampler is not None and not self._sampler.keep():
+            return _NULL_SPAN
         return _Span(self, name, cat, tid, args or None)
 
     def _complete_ns(self, name, cat, tid, start_ns, end_ns, args):
@@ -100,12 +182,18 @@ class Tracer:
         The start is clamped to the tracer's birth so ``ts`` stays
         non-negative (Chrome trace validity) even for a span measured
         before the tracer existed."""
+        if self._sampler is not None and not self._sampler.keep():
+            return
         end_ns = time.perf_counter_ns()
         start_ns = max(end_ns - int(dur_s * 1e9), self._t0_ns)
         self._complete_ns(name, cat, tid, start_ns, end_ns, args)
 
     def instant(self, name, cat="", tid=0, args=None):
-        """Record a ph:"i" thread-scoped instant event."""
+        """Record a ph:"i" thread-scoped instant event.
+
+        Never sampled: milestones are the protocol's skeleton and the
+        timeline profiler needs every one of them.
+        """
         event = {
             "name": name,
             "cat": cat,
@@ -119,6 +207,63 @@ class Tracer:
             event["args"] = args
         self.events.append(event)
 
+    def flow_milestone(self, name, tid, seq_no, epoch=None, bucket=None):
+        """Record the flow event for one consensus milestone.
+
+        The first milestone seen for ``(tid, seq_no)`` opens the flow
+        (ph "s") — this requires epoch and bucket to mint the stable id
+        ``"<epoch>.<seq_no>.<bucket>"``; without them the open is
+        skipped and the whole flow stays silent for that tid.  Later
+        milestones resolve the id from the open-flow table, so terminal
+        sites need only the seq_no.  Never sampled.
+        """
+        key = (tid, seq_no)
+        flow_id = self._flows.get(key)
+        if flow_id is None:
+            if epoch is None or bucket is None:
+                return
+            flow_id = f"{epoch}.{seq_no}.{bucket}"
+            self._flows[key] = flow_id
+            ph = "s"
+        elif name in FLOW_TERMINAL:
+            del self._flows[key]
+            ph = "f"
+        else:
+            ph = "t"
+        event = {
+            "name": name,
+            "cat": "flow",
+            "ph": ph,
+            "id": flow_id,
+            "pid": 0,
+            "tid": tid,
+            "ts": self._now_us(),
+        }
+        if ph == "f":
+            # Bind to the enclosing slice's end rather than the next one.
+            event["bp"] = "e"
+        self.events.append(event)
+
+    def flow_step(self, name, tid, flow_id):
+        """Freestanding ph:"t" flow record with an explicit id.
+
+        Used for milestone families without an open/close pair on one
+        node (checkpoints: each node emits one ``ckpt.stable``); merge.py
+        promotes the earliest/latest record per id to "s"/"f" so the
+        merged trace stays well-formed.  Never sampled.
+        """
+        self.events.append(
+            {
+                "name": name,
+                "cat": "flow",
+                "ph": "t",
+                "id": flow_id,
+                "pid": 0,
+                "tid": tid,
+                "ts": self._now_us(),
+            }
+        )
+
     def chrome_trace(self):
         """The full trace as a Chrome trace-event JSON object."""
         meta = [
@@ -131,6 +276,16 @@ class Tracer:
             }
             for tid, name in sorted(self._thread_names.items())
         ]
+        if self._clock_sync is not None:
+            meta.append(
+                {
+                    "name": CLOCK_SYNC,
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": 0,
+                    "args": dict(self._clock_sync),
+                }
+            )
         return {"traceEvents": meta + self.events}
 
     def write(self, path):

@@ -30,6 +30,12 @@ class Config:
     # Optional callable(state_event) invoked inside the serializer before
     # each event application (the tracing hook; see eventlog.Recorder).
     event_interceptor: object = None
+    # HTTP observability endpoint (GET /metrics, /status, /healthz).
+    # Off by default; set a port to serve (0 binds an ephemeral port,
+    # read back via Node.metrics_address).  Exposition payloads come
+    # from the obsv registry/status module — see obsv/exporter.py.
+    metrics_port: int | None = None
+    metrics_host: str = "127.0.0.1"
 
     def __post_init__(self):
         if self.logger is None:

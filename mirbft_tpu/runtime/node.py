@@ -102,6 +102,17 @@ class Node:
         self._waiters: list[_Waiter] = []
         self._wal_storage = wal_storage
         self._req_storage = req_storage
+        self._exporter = None
+        if config.metrics_port is not None:
+            from ..obsv.exporter import ObsvExporter
+
+            self._exporter = ObsvExporter(
+                host=config.metrics_host,
+                port=config.metrics_port,
+                registry_fn=self._live_registry,
+                status_fn=self._status_json,
+                node_id=config.id,
+            )
         self._thread = threading.Thread(
             target=self._run, name=f"mirbft-serializer-{config.id}", daemon=True
         )
@@ -198,10 +209,36 @@ class Node:
         self._stopped.set()
         self._put(("stop",))
         self._thread.join(timeout=10)
+        self._close_exporter()
 
     @property
     def exit_error(self):
         return self._exit_error
+
+    @property
+    def metrics_address(self):
+        """``(host, port)`` of the HTTP endpoint, or None when disabled."""
+        return self._exporter.address if self._exporter is not None else None
+
+    # -- HTTP endpoint plumbing (runs on exporter request threads) -----------
+
+    def _live_registry(self):
+        from ..obsv import hooks
+
+        return hooks.metrics if hooks.enabled else None
+
+    def _status_json(self):
+        if self._stopped.is_set():
+            return None
+        try:
+            status = self.status(timeout=2.0)
+        except NodeStopped:
+            return None
+        return status.to_json() if status is not None else None
+
+    def _close_exporter(self):
+        if self._exporter is not None:
+            self._exporter.close()
 
     def _put(self, item) -> None:
         if self._stopped.is_set() and item[0] != "stop":
@@ -328,6 +365,9 @@ class Node:
             self._stopped.set()
             for waiter in self._waiters:
                 waiter.expired.set()
+            # Serializer death (clean stop or crash — chaos crash
+            # schedules included) takes the scrape surface down with it.
+            self._close_exporter()
 
     def _flush_outbox(self, actions) -> None:
         from ..core.actions import Actions

@@ -31,6 +31,16 @@ checks the claim against the peer certificate.  In-process and test use
 trust the header, exactly like the reference's test transports.
 
 Frame format: [u32 little-endian total length][varint source][pb.Msg].
+
+Clock-sync hello: the first frame on every freshly dialed connection is
+a hello — the reserved source id ``_HELLO_SRC`` followed by the dialer's
+node id and its ``perf_counter_ns`` monotonic anchor.  The receiver
+records ``local_anchor - remote_anchor`` per peer (``clock_offsets()``),
+which obsv/merge.py uses to align per-node trace timelines.  The
+estimate absorbs the hello's one-way network latency; on a single host
+CLOCK_MONOTONIC is system-wide so it is exact up to that latency.  Old
+frames are unaffected: a hello is just a frame whose source id no real
+node can carry.
 """
 
 from __future__ import annotations
@@ -48,6 +58,20 @@ from .processor import Link
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
+
+# Reserved frame source id marking a clock-sync hello.  Real node ids are
+# small integers assigned by NetworkConfig; 2**62 keeps the varint within
+# the codec's 64-bit bound while staying unmistakably out of range.
+_HELLO_SRC = 1 << 62
+
+
+def _hello_frame(node_id: int) -> bytes:
+    payload = (
+        wire.encode_varint(_HELLO_SRC)
+        + wire.encode_varint(node_id)
+        + wire.encode_varint(time.perf_counter_ns())
+    )
+    return _LEN.pack(len(payload)) + payload
 
 
 def _frame_outcome(outcome: str, n: int = 1) -> None:
@@ -199,6 +223,16 @@ class _PeerChannel:
             else:
                 self.connects += 1
                 _dial_outcome("connected")
+                # First frame on a fresh connection: the clock-sync
+                # hello (monotonic anchor for trace alignment).  Best
+                # effort — a failed hello just means the sender loop
+                # discovers the dead socket on the next frame.
+                conn_, send_lock = entry
+                try:
+                    with send_lock:
+                        conn_.sendall(_hello_frame(transport.node_id))
+                except OSError:
+                    pass
             return entry
 
     def _drop_conn(self, entry) -> None:
@@ -236,6 +270,9 @@ class TcpTransport:
         self._channels: dict[int, _PeerChannel] = {}
         # Sends to peers never registered via connect(): dropped, counted.
         self.dropped_unknown = 0
+        # peer id -> (local perf_counter_ns - peer perf_counter_ns),
+        # estimated from the clock-sync hello on each inbound connection.
+        self._clock_offsets: dict[int, int] = {}
         # Accepted inbound sockets.  close() must shutdown+close these too:
         # leaving them open keeps their read threads blocked in recv, keeps
         # the port occupied past a rebind, and — worse — lets a "closed"
@@ -370,17 +407,33 @@ class TcpTransport:
             buf += chunk
         return buf
 
+    def clock_offsets(self) -> dict[int, int]:
+        """Peer id -> estimated (local - peer) monotonic offset in ns,
+        learned from clock-sync hellos.  Feed to
+        ``Tracer.set_clock_sync`` so obsv/merge.py can align this node's
+        trace with its peers'."""
+        with self._lock:
+            return dict(self._clock_offsets)
+
     def _deliver(self, payload: bytes) -> None:
         if self._closed.is_set():
             return  # closed transport must never deliver
-        node = self._node
-        if node is None:
-            return  # not serving yet: dropped
         try:
             source, offset = wire.decode_varint(payload, 0)
+            if source == _HELLO_SRC:
+                peer_id, offset = wire.decode_varint(payload, offset)
+                remote_ns, _ = wire.decode_varint(payload, offset)
+                with self._lock:
+                    self._clock_offsets[peer_id] = (
+                        time.perf_counter_ns() - remote_ns
+                    )
+                return
             msg = pb.decode(pb.Msg, payload[offset:])
         except ValueError:
             return  # malformed frame from a faulty peer: dropped
+        node = self._node
+        if node is None:
+            return  # not serving yet: dropped
         from .node import NodeStopped
 
         try:

@@ -102,3 +102,62 @@ def test_every_cataloged_metric_is_documented():
     doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
     missing = [name for name in CATALOG if name not in doc]
     assert not missing, f"undocumented metrics: {missing}"
+
+
+def test_every_label_and_budget_is_documented():
+    """Every declared label name (backtick-quoted) and every explicit
+    cardinality budget must appear in docs/OBSERVABILITY.md, alongside
+    the default budget — the documented bound is the contract the
+    registry enforces."""
+    from mirbft_tpu.obsv.metrics import (
+        CARDINALITY,
+        CATALOG,
+        CATALOG_LABELS,
+        DEFAULT_CARDINALITY,
+    )
+
+    assert set(CATALOG_LABELS) == set(CATALOG), (
+        "CATALOG and CATALOG_LABELS must declare the same metric names"
+    )
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    labels = {label for names in CATALOG_LABELS.values() for label in names}
+    missing = [label for label in sorted(labels) if f"`{label}`" not in doc]
+    assert not missing, f"undocumented label names: {missing}"
+    assert str(DEFAULT_CARDINALITY) in doc, "default cardinality budget undocumented"
+    for name, budget in CARDINALITY.items():
+        assert name in doc and str(budget) in doc, (
+            f"cardinality budget for {name} ({budget}) undocumented"
+        )
+
+
+def test_linter_bans_http_server_outside_obsv(tmp_path):
+    """W8: only obsv/ may touch http.server; everything else in
+    mirbft_tpu must expose through the exporter."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "runtime" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("import http.server as hs\nx = hs\n")
+    findings = lint.check_file(outside)
+    assert any("W8" in line for line in findings), findings
+
+    fromstyle = tmp_path / "mirbft_tpu" / "core" / "sneaky2.py"
+    fromstyle.parent.mkdir(parents=True)
+    fromstyle.write_text(
+        "from http.server import BaseHTTPRequestHandler\n"
+        "x = BaseHTTPRequestHandler\n"
+    )
+    assert any("W8" in line for line in lint.check_file(fromstyle))
+
+    inside = tmp_path / "mirbft_tpu" / "obsv" / "fine.py"
+    inside.parent.mkdir(parents=True)
+    inside.write_text("import http.server as hs\nx = hs\n")
+    assert not any("W8" in line for line in lint.check_file(inside))
+
+    # The real exporter is the one sanctioned http.server user.
+    assert not any(
+        "W8" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "obsv" / "exporter.py"
+        )
+    )

@@ -355,3 +355,456 @@ def test_cli_smoke_writes_trace(tmp_path):
     assert "phase" in proc.stdout and "p99_ms" in proc.stdout
     trace = json.loads(out.read_text())
     assert any(e["ph"] == "i" for e in trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Flow events, per-node traces, merge
+# ---------------------------------------------------------------------------
+
+
+def _flow_records(events):
+    return [
+        e for e in events if e.get("cat") == "flow" and e["ph"] in ("s", "t", "f")
+    ]
+
+
+def _run_four_node_traces():
+    """4-node seeded engine run -> (per-node traces dict, merged trace)."""
+    from mirbft_tpu.obsv.merge import merge_traces, split_node_traces
+    from mirbft_tpu.testengine.engine import BasicRecorder
+
+    _, tracer = hooks.enable(trace=True)
+    try:
+        rec = BasicRecorder(4, 4, 30, batch_size=2, seed=0, record=False)
+        rec.drain_clients(max_steps=2_000_000)
+    finally:
+        hooks.disable()
+    per_node = split_node_traces(tracer, range(4))
+    return per_node, merge_traces(per_node.values())
+
+
+@pytest.fixture(scope="module")
+def four_node_traces():
+    return _run_four_node_traces()
+
+
+def test_flow_events_well_formed_per_node(four_node_traces):
+    """Every seq flow a node opens (s) it also finishes (f), and the id
+    encodes a unique (epoch, seq_no, bucket) triple."""
+    per_node, _ = four_node_traces
+    assert set(per_node) == {0, 1, 2, 3}
+    for node, trace in per_node.items():
+        flows = _flow_records(trace["traceEvents"])
+        assert flows, f"node {node} recorded no flow events"
+        by_id = {}
+        for record in flows:
+            by_id.setdefault(record["id"], []).append(record)
+        triples = set()
+        for flow_id, records in by_id.items():
+            if flow_id.startswith("c."):
+                continue  # checkpoint step flows are promoted at merge
+            epoch, seq, bucket = (int(x) for x in flow_id.split("."))
+            assert (epoch, seq, bucket) not in triples
+            triples.add((epoch, seq, bucket))
+            phases = [r["ph"] for r in records]
+            assert phases.count("s") == 1, (node, flow_id, phases)
+            assert phases.count("f") == 1, (node, flow_id, phases)
+            # The flow id triple matches the milestone metadata.
+            assert seq % 4 == bucket  # 4 nodes -> 4 buckets, seq % buckets
+
+
+def test_merged_trace_connects_three_plus_lanes(four_node_traces):
+    """Acceptance: the merged trace is valid Chrome JSON and at least one
+    committed seq's flow touches >= 3 distinct node lanes."""
+    _, merged = four_node_traces
+    # Valid Chrome trace JSON: serializes, every event has the core keys.
+    events = json.loads(json.dumps(merged))["traceEvents"]
+    for e in events:
+        assert "ph" in e and "pid" in e and "ts" in e or e["ph"] == "M"
+    flows = _flow_records(events)
+    by_id = {}
+    for record in flows:
+        by_id.setdefault(record["id"], []).append(record)
+    assert by_id, "merged trace lost its flow records"
+    spanning = [
+        flow_id
+        for flow_id, records in by_id.items()
+        if not flow_id.startswith("c.")
+        and len({r["pid"] for r in records}) >= 3
+    ]
+    assert spanning, "no committed seq flow connects >= 3 node lanes"
+    # Merged flow hygiene: exactly one s and one f per id, s first f last.
+    for flow_id, records in by_id.items():
+        records.sort(key=lambda r: r["ts"])
+        phases = [r["ph"] for r in records]
+        assert phases.count("s") == 1 and phases.count("f") == 1, (
+            flow_id,
+            phases,
+        )
+        assert phases[0] == "s" and phases[-1] == "f", (flow_id, phases)
+    # Checkpoint step flows got promoted into full s..f flows.
+    assert any(flow_id.startswith("c.") for flow_id in by_id)
+
+
+_MILESTONE_ORDER = {
+    "seq.allocated": 0,
+    "seq.preprepared": 1,
+    "seq.prepared": 2,
+    "seq.commit_quorum": 3,
+    "seq.committed": 4,
+}
+
+
+def test_merged_trace_milestones_monotonic_per_lane(four_node_traces):
+    """On every node lane, each seq's milestones appear in protocol order
+    with non-decreasing merged timestamps."""
+    _, merged = four_node_traces
+    per_lane_seq = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") == "i" and e["name"] in _MILESTONE_ORDER:
+            key = (e["pid"], e["args"]["seq"])
+            per_lane_seq.setdefault(key, []).append(e)
+    assert per_lane_seq
+    for (pid, seq), events in per_lane_seq.items():
+        assert all(e["ts"] >= 0 for e in events)
+        ordered = sorted(events, key=lambda e: _MILESTONE_ORDER[e["name"]])
+        times = [e["ts"] for e in ordered]
+        assert times == sorted(times), (pid, seq, [
+            (e["name"], e["ts"]) for e in ordered
+        ])
+
+
+def test_merge_aligns_clock_offsets():
+    """Two traces whose events mark the same physical instant in
+    different monotonic domains land on the same merged timestamp once
+    the reference node's hello-estimated offsets are applied."""
+    from mirbft_tpu.obsv.merge import merge_traces
+
+    t0_a = 50_000_000_000
+    t0_b = 2_000_000  # a different monotonic domain entirely
+    # Physical instant: t0_a + 10ms on A's clock; B's clock reads
+    # t0_b + 3ms at that same instant, so A's offset for B is the gap.
+    offset_ab = (t0_a + 10_000_000) - (t0_b + 3_000_000)
+
+    def trace(node, t0, ts_us, offsets):
+        return {
+            "traceEvents": [
+                {
+                    "name": "clock_sync",
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {"node": node, "t0_ns": t0, "offsets_ns": offsets},
+                },
+                {
+                    "name": "seq.prepared",
+                    "cat": "flow",
+                    "ph": "t",
+                    "id": "1.5.0",
+                    "pid": 0,
+                    "tid": node,
+                    "ts": ts_us,
+                },
+            ]
+        }
+
+    merged = merge_traces(
+        [
+            trace(0, t0_a, 10_000.0, {"1": offset_ab}),
+            trace(1, t0_b, 3_000.0, {}),
+        ]
+    )
+    flows = _flow_records(merged["traceEvents"])
+    assert len(flows) == 2
+    assert abs(flows[0]["ts"] - flows[1]["ts"]) < 1e-6
+    assert {f["pid"] for f in flows} == {0, 1}
+    # The shared-id step pair was promoted to one s and one f.
+    assert sorted(f["ph"] for f in flows) == ["f", "s"]
+
+
+# ---------------------------------------------------------------------------
+# Span sampling
+# ---------------------------------------------------------------------------
+
+
+def test_span_sampling_is_deterministic_and_spares_milestones():
+    from mirbft_tpu.obsv.trace import SpanSampler
+
+    def spans_kept(seed):
+        tracer = Tracer(sampler=SpanSampler(0.25, seed=seed))
+        kept = []
+        for i in range(100):
+            with tracer.span(f"s{i}", tid=0):
+                pass
+        for e in tracer.events:
+            if e["ph"] == "X":
+                kept.append(e["name"])
+        return kept
+
+    kept_a = spans_kept(seed=0)
+    assert len(kept_a) == 25  # stride 4 over 100 spans
+    assert kept_a == spans_kept(seed=0)  # reproducible
+    assert kept_a != spans_kept(seed=1)  # seed-derived phase
+
+    # Milestones and flow records are never thinned.
+    tracer = Tracer(sampler=SpanSampler(0.01, seed=0))
+    for seq in range(50):
+        tracer.instant("seq.allocated", cat="consensus", tid=0)
+        tracer.flow_milestone("seq.allocated", 0, seq, epoch=1, bucket=0)
+    assert sum(e["ph"] == "i" for e in tracer.events) == 50
+    assert len(_flow_records(tracer.events)) == 50
+
+
+def test_hooks_expose_sample_rate():
+    try:
+        _, tracer = hooks.enable(trace=True, sample_rate=0.5, sample_seed=3)
+        assert hooks.sample_rate == 0.5
+        assert tracer._sampler is not None and tracer._sampler.stride == 2
+    finally:
+        hooks.disable()
+    assert hooks.sample_rate is None
+
+
+# ---------------------------------------------------------------------------
+# Label catalog + cardinality budget
+# ---------------------------------------------------------------------------
+
+
+def test_strict_registry_rejects_undeclared_labels():
+    reg = Registry()
+    with pytest.raises(KeyError):
+        reg.counter("mirbft_wal_appends_total", bogus="x")
+    # Declared labels (and subsets) pass.
+    reg.counter(
+        "mirbft_seq_milestones_total", milestone="seq.prepared",
+        epoch="1", bucket="0",
+    ).inc()
+    reg.counter(
+        "mirbft_seq_milestones_total", milestone="seq.committed"
+    ).inc()
+
+
+def test_cardinality_budget_rejects_registration():
+    from mirbft_tpu.obsv.metrics import DEFAULT_CARDINALITY, CardinalityError
+
+    reg = Registry()
+    for i in range(DEFAULT_CARDINALITY):
+        reg.counter("mirbft_chaos_dropped_total", scenario=f"s{i}").inc()
+    with pytest.raises(CardinalityError):
+        reg.counter("mirbft_chaos_dropped_total", scenario="one-too-many")
+    # Existing series stay reachable at the bound.
+    assert reg.counter("mirbft_chaos_dropped_total", scenario="s0").value == 1
+
+
+def test_milestone_degrades_gracefully_over_budget():
+    """An epoch/bucket storm past the budget must not crash consensus:
+    the counter inc is skipped, the trace instant still lands."""
+    from mirbft_tpu.obsv import metrics as metrics_mod
+
+    saved = metrics_mod.CARDINALITY.get("mirbft_seq_milestones_total")
+    metrics_mod.CARDINALITY["mirbft_seq_milestones_total"] = 1
+    try:
+        reg, tracer = hooks.enable(trace=True)
+        hooks.milestone("seq.prepared", 0, 1, epoch=1, bucket=0)
+        hooks.milestone("seq.prepared", 0, 2, epoch=2, bucket=1)  # over budget
+        snap = reg.snapshot()["mirbft_seq_milestones_total"]["series"]
+        assert len(snap) == 1
+        assert sum(e["ph"] == "i" for e in tracer.events) == 2
+    finally:
+        hooks.disable()
+        metrics_mod.CARDINALITY["mirbft_seq_milestones_total"] = saved
+
+
+# ---------------------------------------------------------------------------
+# Live HTTP endpoints on the runtime node
+# ---------------------------------------------------------------------------
+
+
+def _get(url, timeout=5):
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def test_node_endpoints_round_trip():
+    from mirbft_tpu.runtime.config import Config
+    from mirbft_tpu.runtime.node import Node, standard_initial_network_state
+
+    metrics, _ = hooks.enable()
+    node = None
+    try:
+        metrics.counter("mirbft_wal_appends_total").inc(3)
+        node = Node.start_new(
+            Config(id=0, metrics_port=0),
+            standard_initial_network_state(1, [0]),
+        )
+        host, port = node.metrics_address
+        base = f"http://{host}:{port}"
+
+        status_code, text = _get(base + "/metrics")
+        assert status_code == 200
+        assert "# TYPE mirbft_wal_appends_total counter" in text
+        assert "mirbft_wal_appends_total 3" in text
+
+        status_code, text = _get(base + "/status")
+        assert status_code == 200
+        parsed = json.loads(text)
+        assert parsed  # valid, non-empty state machine status JSON
+
+        status_code, text = _get(base + "/healthz")
+        assert status_code == 200
+        assert json.loads(text) == {"ok": True, "node_id": 0}
+
+        from urllib.error import HTTPError
+
+        with pytest.raises(HTTPError) as err:
+            _get(base + "/nope")
+        assert err.value.code == 404
+    finally:
+        hooks.disable()
+        if node is not None:
+            node.stop()
+    # Clean shutdown: the port no longer accepts connections.
+    import socket as socket_mod
+
+    with pytest.raises(OSError):
+        socket_mod.create_connection((host, port), timeout=1).close()
+
+
+def test_node_endpoint_off_by_default():
+    from mirbft_tpu.runtime.config import Config
+    from mirbft_tpu.runtime.node import Node, standard_initial_network_state
+
+    node = Node.start_new(
+        Config(id=0), standard_initial_network_state(1, [0])
+    )
+    try:
+        assert node.metrics_address is None
+        assert node._exporter is None
+    finally:
+        node.stop()
+
+
+def test_metrics_endpoint_reports_disabled_hooks():
+    from mirbft_tpu.obsv.exporter import ObsvExporter
+
+    assert not hooks.enabled
+    exporter = ObsvExporter(
+        registry_fn=lambda: hooks.metrics if hooks.enabled else None
+    )
+    try:
+        host, port = exporter.address
+        status_code, text = _get(f"http://{host}:{port}/metrics")
+        assert status_code == 200
+        assert "disabled" in text
+    finally:
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# Timeline-diff regression gate
+# ---------------------------------------------------------------------------
+
+
+def _milestone_trace(prepare_ms, seqs=40):
+    events = []
+    for seq in range(1, seqs + 1):
+        base = seq * 1000
+        for name, offset in (
+            ("seq.allocated", 0),
+            ("seq.preprepared", 10),
+            ("seq.prepared", 10 + prepare_ms),
+            ("seq.commit_quorum", 15 + prepare_ms),
+        ):
+            events.append(
+                {
+                    "name": name,
+                    "ph": "i",
+                    "s": "t",
+                    "pid": 0,
+                    "tid": 0,
+                    "ts": 0,
+                    "args": {"node": 0, "seq": seq, "sim_ms": base + offset},
+                }
+            )
+    return {"traceEvents": events}
+
+
+def test_diff_flags_p95_regression_on_traces():
+    from mirbft_tpu.obsv.diff import diff_series, extract_series
+
+    a = extract_series(_milestone_trace(prepare_ms=50))
+    b = extract_series(_milestone_trace(prepare_ms=100))
+    assert a["phase.prepare.p95_ms"] == 50
+    report = diff_series(a, b, threshold_pct=10.0)
+    assert not report["ok"]
+    regressed = {r["series"] for r in report["regressions"]}
+    assert "phase.prepare.p95_ms" in regressed
+
+    equal = diff_series(a, dict(a), threshold_pct=10.0)
+    assert equal["ok"] and not equal["regressions"]
+
+
+def test_diff_direction_heuristics():
+    from mirbft_tpu.obsv.diff import diff_series
+
+    a = {"committed_reqs_per_sec": 100.0, "rung3_verify_p99_ms": 10.0}
+    # Throughput dropped 50%, latency doubled: both regress.
+    b = {"committed_reqs_per_sec": 50.0, "rung3_verify_p99_ms": 20.0}
+    report = diff_series(a, b, threshold_pct=10.0)
+    assert {r["series"] for r in report["regressions"]} == set(a)
+    # The same deltas in the *good* direction do not gate.
+    report = diff_series(b, a, threshold_pct=10.0)
+    assert report["ok"]
+
+
+def test_diff_cli_verdicts(tmp_path):
+    """--diff exits 1 on a >= threshold p95 regression, 0 on an equal
+    pair, and emits a machine-readable JSON verdict line."""
+    base = {
+        "metric": "committed_reqs_per_sec_per_chip",
+        "value": 900.0,
+        "prepare_p95_ms": 40.0,
+        "stages": {"ladder_host": {"status": "ok", "seconds": 12.0}},
+        "engine_gauges": {"ladder_host": {"events": 5000, "sim_ms": 800}},
+    }
+    regressed = dict(base)
+    regressed["prepare_p95_ms"] = 80.0
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    eq = tmp_path / "eq.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(regressed))
+    eq.write_text(json.dumps(base))
+
+    def run_diff(x, y):
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "mirbft_tpu.obsv",
+                "--diff",
+                str(x),
+                str(y),
+                "--threshold",
+                "25",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    bad = run_diff(a, b)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    verdict = json.loads(bad.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is False
+    assert any(
+        r["series"] == "prepare_p95_ms" for r in verdict["regressions"]
+    )
+
+    good = run_diff(a, eq)
+    assert good.returncode == 0, good.stdout + good.stderr
+    verdict = json.loads(good.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True and not verdict["regressions"]

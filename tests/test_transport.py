@@ -553,3 +553,41 @@ def test_consensus_survives_transport_kill_and_restore():
         for replica in replicas:
             replica.stop()
     assert all(r.node.exit_error is None for r in replicas)
+
+
+def test_clock_sync_hello_records_offset():
+    """The first frame on a fresh dial is the clock-sync hello: the
+    receiver learns the dialer's monotonic anchor and exposes the
+    (local - peer) offset for trace alignment.  Same host, same
+    CLOCK_MONOTONIC: the offset is bounded by the hello's in-flight
+    latency, not by clock skew."""
+    received = []
+
+    class _Sink:
+        def step(self, source, msg):
+            received.append((source, type(msg.type).__name__))
+
+    sender = TcpTransport(0)
+    receiver = TcpTransport(1)
+    try:
+        sender.connect(1, receiver.address)
+        receiver.serve(_Sink())
+        sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=1)))
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # The hello is transparent to the protocol stream...
+        assert received == [(0, "Suspect")]
+        # ...but the receiver learned the dialer's clock offset.
+        deadline = time.monotonic() + 5
+        while 0 not in receiver.clock_offsets() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        offsets = receiver.clock_offsets()
+        assert 0 in offsets, "no clock offset learned from hello"
+        # Shared monotonic domain: offset ~ one-way latency (< 1s by miles).
+        assert 0 <= offsets[0] < 1_000_000_000
+        # The sender never dialed back, so it learned nothing.
+        assert receiver.node_id not in sender.clock_offsets()
+    finally:
+        sender.close()
+        receiver.close()

@@ -18,6 +18,11 @@ enforces the defect classes that have actually bitten BFT codebases:
   ``MONOTONIC_ONLY_TREES`` (or when forced via the ``monotonic_only``
   parameter); eventlog timestamps, for example, legitimately want the
   wall clock.
+- W8 ``http.server`` outside ``mirbft_tpu/obsv/`` — metric/status
+  exposition must go through the obsv exporter and its catalog
+  renderer; ad-hoc handlers writing registry internals onto sockets
+  bypass the catalog/cardinality contract.  Scoped to ``mirbft_tpu/``
+  (tests and tools may use HTTP clients/servers freely).
 
 Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
 Also enforced in CI-equivalent form by ``tests/test_lint.py``.
@@ -97,6 +102,13 @@ MONOTONIC_ONLY_TREES = (
 def _in_monotonic_scope(path: Path) -> bool:
     posix = path.resolve().as_posix()
     return any(fragment in posix for fragment in MONOTONIC_ONLY_TREES)
+
+
+def _in_exposition_scope(path: Path) -> bool:
+    """True for mirbft_tpu files outside obsv/ — where W8 bans
+    http.server."""
+    posix = path.resolve().as_posix()
+    return "mirbft_tpu/" in posix and "mirbft_tpu/obsv/" not in posix
 
 
 def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
@@ -181,6 +193,28 @@ def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
                         f"{path}:{node.lineno}: W7 'from time import time' in "
                         "monotonic-only code (use time.perf_counter)"
                     )
+        if _in_exposition_scope(path):
+            hit = False
+            if isinstance(node, ast.Import):
+                hit = any(
+                    alias.name == "http.server" or alias.name.startswith("http.server.")
+                    for alias in node.names
+                )
+            elif isinstance(node, ast.ImportFrom):
+                hit = node.module is not None and (
+                    node.module == "http.server"
+                    or node.module.startswith("http.server.")
+                    or (
+                        node.module == "http"
+                        and any(alias.name == "server" for alias in node.names)
+                    )
+                )
+            if hit:
+                findings.append(
+                    f"{path}:{node.lineno}: W8 http.server outside obsv/ "
+                    "(exposition must go through obsv.exporter and the "
+                    "catalog renderer)"
+                )
 
     return findings
 
