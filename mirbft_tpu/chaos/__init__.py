@@ -1,11 +1,12 @@
-"""Chaos campaign harness: seeded fault-scenario matrix + invariants.
+"""Chaos campaign harness: seeded fault-scenario matrix + invariants,
+runnable on two engines.
 
 The testengine's mangler DSL (testengine/manglers.py) injects individual
 faults; this package turns it into a *campaign*: a reproducible matrix of
 scenarios — message loss, jitter, duplication, crash + restart schedules,
-network partitions with heal, and device-plane faults against the crypto
-planes — each executed under a seeded Recorder and then audited by an
-invariant checker:
+network partitions with heal, epoch-change-targeted leader isolation,
+device-plane faults against the crypto planes, and signed-mode verifier
+faults — each audited by an invariant checker:
 
 - **No fork**: committed prefixes agree across nodes (any two nodes that
   committed a sequence number committed the same requests there, in the
@@ -16,43 +17,84 @@ invariant checker:
 - **Bounded recovery**: the run converges within a bound of the last
   disruption (partition heal / node restart) — liveness degrades, never
   dies.
+- **Commit resumption**: after the last heal/restart, the cluster
+  *resumes committing* within the bound, not merely "eventually".
+
+One scenario schema, two engines: the deterministic runner (runner.py)
+lowers scenarios onto the simulated testengine, while the live driver
+(live.py) lowers the same scenarios onto a real loopback TCP cluster —
+real ``runtime.Node`` threads, socket-level partition proxies, crash-kill
++ ``Node.restart`` from on-disk WALs, and failing fsyncs.
 
 Entry points::
 
-    python -m mirbft_tpu.chaos                 # full matrix
+    python -m mirbft_tpu.chaos                 # full deterministic matrix
     python -m mirbft_tpu.chaos --smoke         # the tier-1 subset
+    python -m mirbft_tpu.chaos --live          # real-cluster campaign
+    python -m mirbft_tpu.chaos --live --smoke  # tier-1 live smoke
     python -m mirbft_tpu.chaos --seed 7 --only partition
 
-See docs/CHAOS.md for the scenario catalogue.
+See docs/CHAOS.md for the scenario catalogue and the live-mode knobs.
 """
 
-from .faults import FlakyDigestBackend
+from .faults import FlakyDigestBackend, FlakyVerifierBackend
 from .invariants import (
     CrashSnapshot,
     InvariantViolation,
     check_bounded_recovery,
+    check_commit_resumption,
     check_durable_prefix,
     check_full_convergence,
     check_no_fork,
 )
+from .live import (
+    DurableChainLog,
+    LiveCluster,
+    PartitionProxy,
+    run_live_campaign,
+    run_live_scenario,
+)
 from .runner import CampaignResult, ScenarioResult, run_campaign, run_scenario
-from .scenarios import SMOKE_NAMES, CrashPoint, Scenario, matrix, smoke_matrix
+from .scenarios import (
+    LIVE_SMOKE_NAMES,
+    SMOKE_NAMES,
+    CrashPoint,
+    PartitionWindow,
+    Scenario,
+    StorageFault,
+    live_matrix,
+    live_smoke_matrix,
+    matrix,
+    smoke_matrix,
+)
 
 __all__ = [
     "CampaignResult",
     "CrashPoint",
     "CrashSnapshot",
+    "DurableChainLog",
     "FlakyDigestBackend",
+    "FlakyVerifierBackend",
     "InvariantViolation",
+    "LIVE_SMOKE_NAMES",
+    "LiveCluster",
+    "PartitionProxy",
+    "PartitionWindow",
     "Scenario",
     "ScenarioResult",
     "SMOKE_NAMES",
+    "StorageFault",
     "check_bounded_recovery",
+    "check_commit_resumption",
     "check_durable_prefix",
     "check_full_convergence",
     "check_no_fork",
+    "live_matrix",
+    "live_smoke_matrix",
     "matrix",
     "run_campaign",
+    "run_live_campaign",
+    "run_live_scenario",
     "run_scenario",
     "smoke_matrix",
 ]

@@ -1,5 +1,9 @@
 """CLI entry: ``python -m mirbft_tpu.chaos [--seed N] [--seeds K] [--smoke]
-[--only S]``.
+[--live] [--only S]``.
+
+``--live`` runs the campaign against a real loopback TCP cluster
+(chaos/live.py) instead of the deterministic testengine; ``--smoke``
+selects each mode's tier-1 subset.
 
 Exit status 0 iff every selected scenario passed all invariants (under
 every seed of the sweep, when ``--seeds`` > 1)."""
@@ -9,14 +13,16 @@ from __future__ import annotations
 import argparse
 import sys
 
+from .live import run_live_campaign
 from .runner import run_campaign
-from .scenarios import matrix, smoke_matrix
+from .scenarios import live_matrix, live_smoke_matrix, matrix, smoke_matrix
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mirbft_tpu.chaos",
-        description="Seeded chaos campaign over the mirbft-tpu testengine.",
+        description="Seeded chaos campaign over the mirbft-tpu testengine "
+        "(deterministic) or a real loopback TCP cluster (--live).",
     )
     parser.add_argument(
         "--seed", type=int, default=0, help="campaign base seed (default 0)"
@@ -31,7 +37,13 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--smoke",
         action="store_true",
-        help="run only the tier-1 smoke subset (3 scenarios)",
+        help="run only the tier-1 smoke subset",
+    )
+    parser.add_argument(
+        "--live",
+        action="store_true",
+        help="run against a real loopback TCP cluster (real nodes, "
+        "sockets, fsyncs) instead of the deterministic testengine",
     )
     parser.add_argument(
         "--only",
@@ -39,11 +51,22 @@ def main(argv=None) -> int:
         help="run only scenarios whose name contains this substring",
     )
     parser.add_argument(
+        "--budget",
+        type=float,
+        default=90.0,
+        metavar="S",
+        help="per-scenario wall-clock budget in seconds (--live only, "
+        "default 90)",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     args = parser.parse_args(argv)
 
-    scenarios = smoke_matrix() if args.smoke else matrix()
+    if args.live:
+        scenarios = live_smoke_matrix() if args.smoke else live_matrix()
+    else:
+        scenarios = smoke_matrix() if args.smoke else matrix()
     if args.only:
         scenarios = [s for s in scenarios if args.only in s.name]
     if not scenarios:
@@ -60,8 +83,13 @@ def main(argv=None) -> int:
     all_passed = True
     good_campaigns = 0
     for seed in range(args.seed, args.seed + args.seeds):
-        campaign = run_campaign(scenarios, seed=seed)
-        print(campaign.report())
+        if args.live:
+            campaign = run_live_campaign(
+                scenarios, seed=seed, budget_s=args.budget
+            )
+        else:
+            campaign = run_campaign(scenarios, seed=seed)
+        print(campaign.report(), flush=True)
         all_passed = all_passed and campaign.passed
         good_campaigns += campaign.passed
     if args.seeds > 1:

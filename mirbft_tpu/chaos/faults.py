@@ -13,6 +13,7 @@ from __future__ import annotations
 import time
 
 from ..testengine.crypto_plane import DevicePlaneError, _host_digest_many
+from ..testengine.signing import host_verifier
 
 MODES = ("die", "short", "slow")
 
@@ -63,3 +64,44 @@ class FlakyDigestBackend:
                 return self.backend(msgs)[: len(msgs) // 2]
             time.sleep(self.delay_s)
         return self.backend(msgs)
+
+
+class FlakyVerifierBackend:
+    """The signature-plane twin of FlakyDigestBackend: a
+    ``host_verifier``-compatible callable (items of
+    ``(client_id, req_no, data)`` -> verdicts) that misbehaves for calls
+    ``fail_from <= i < fail_until`` and is healthy otherwise.  Same
+    call-indexed determinism: while the plane's breaker is open only
+    probes reach the backend, so the recovery point is fixed per
+    scenario."""
+
+    def __init__(
+        self,
+        fail_from: int = 0,
+        fail_until: int = 0,
+        mode: str = "die",
+        delay_s: float = 0.002,
+        backend=None,
+    ):
+        assert mode in MODES, f"mode must be one of {MODES}"
+        self.fail_from = fail_from
+        self.fail_until = fail_until
+        self.mode = mode
+        self.delay_s = delay_s
+        self.backend = backend if backend is not None else host_verifier
+        self.calls = 0
+        self.injected = 0
+
+    def __call__(self, items: list) -> list:
+        index = self.calls
+        self.calls += 1
+        if self.fail_from <= index < self.fail_until:
+            self.injected += 1
+            if self.mode == "die":
+                raise DevicePlaneError(
+                    f"injected verifier loss (call {index})"
+                )
+            if self.mode == "short":
+                return self.backend(items)[: len(items) // 2]
+            time.sleep(self.delay_s)
+        return self.backend(items)

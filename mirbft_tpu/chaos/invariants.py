@@ -105,6 +105,27 @@ def check_full_convergence(rec) -> None:
         )
 
 
+def check_commit_resumption(
+    commit_times_ms: list, heal_ms: int, bound_ms: int
+) -> None:
+    """Liveness after heal, pointwise: the cluster did not merely finish
+    eventually — it *resumed committing* within ``bound_ms`` of the heal
+    (or restart) instant.  ``commit_times_ms`` is every instant at which
+    the total committed-request count grew (simulated ms under the
+    deterministic runner, wall ms under the live driver)."""
+    after = [t for t in commit_times_ms if t >= heal_ms]
+    if not after:
+        raise InvariantViolation(
+            f"no commits at all after the heal at {heal_ms}ms"
+        )
+    first = min(after)
+    if first - heal_ms > bound_ms:
+        raise InvariantViolation(
+            f"commits resumed {first - heal_ms}ms after the heal at "
+            f"{heal_ms}ms (bound: {bound_ms}ms)"
+        )
+
+
 def check_bounded_recovery(
     completion_ms: int, last_disruption_end_ms: int, bound_ms: int
 ) -> None:

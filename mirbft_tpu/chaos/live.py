@@ -1,0 +1,1002 @@
+"""Live-cluster chaos: the fault campaign against the real TCP runtime.
+
+The deterministic runner (runner.py) executes scenarios on the simulated
+testengine; this driver lowers the *same* structured Scenario schema onto
+a real cluster: N ``runtime.Node`` instances over ``TcpTransport`` on
+loopback, with real serializer/consumer threads, real WAL/reqstore files,
+and real fsyncs.  Faults become what they are in production:
+
+- ``PartitionWindow``  -> socket-level partition proxies, one per directed
+  transport edge; cutting an edge closes its listener so the dialing
+  sender thread walks its reconnect backoff, healing re-binds the port.
+- ``CrashPoint``       -> crash-kill the replica (no final fsync) and
+  reboot it from its on-disk WAL/reqstore via ``Node.restart``.
+- ``StorageFault``     -> the WAL/reqstore fsync seams start raising
+  OSError; the runtime fails loudly, the driver crash-kills it, and the
+  reboot gets healthy storage.
+- ``drop_pct``         -> a seeded ``TransportFault`` dropping frames at
+  the send seam (surfaced via the transport's ``dropped_fault`` counter).
+- ``signed``           -> clients Ed25519-sign, the driver verifies at
+  ingress through the scenario's SignaturePlane (flaky backends walk the
+  breaker exactly as under the deterministic engine), and one forged
+  request must be stopped cold.
+
+After convergence the same invariant checkers audit the run — no fork,
+durable prefix across every crash-restart, bounded recovery — plus the
+liveness invariant: commits *resume* within the bound after the last
+heal/restart instant.  Epoch-change scenarios are additionally asserted
+through the obsv ``epoch.active`` milestone counter, so the run proves
+the change happened through the same telemetry operators would watch.
+
+Scenario fault instants are authored in simulated ms against the
+testengine's 500ms tick; the driver re-times them against its real tick
+period (``scale_s``), so "isolated past the suspect timeout" means the
+same thing under both engines.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import shutil
+import socket
+import tempfile
+import threading
+import time
+from types import SimpleNamespace
+
+from .. import pb
+from ..obsv import hooks
+from ..obsv.metrics import Registry
+from ..runtime import (
+    Config,
+    FileRequestStore,
+    FileWal,
+    Node,
+    SerialProcessor,
+)
+from ..runtime.node import NodeStopped, standard_initial_network_state
+from ..runtime.processor import Log
+from ..runtime.transport import TcpTransport, TransportFault
+from .invariants import (
+    CrashSnapshot,
+    InvariantViolation,
+    check_bounded_recovery,
+    check_commit_resumption,
+    check_durable_prefix,
+    check_no_fork,
+)
+from .runner import CampaignResult, ScenarioResult
+from .scenarios import Scenario, live_matrix
+
+# The deterministic testengine ticks every 500 simulated ms; scenario
+# fault instants are authored on that clock.
+SIM_TICK_MS = 500
+
+# Wall-clock floor for the scaled recovery bound: scheduler and fsync
+# jitter on a loaded CI host must not fail a scenario whose scaled bound
+# would otherwise be a couple of seconds.
+MIN_RECOVERY_BOUND_MS = 15_000
+
+
+def _shutdown_close(sock) -> None:
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class DropFault(TransportFault):
+    """Uniform seeded frame loss at the transport send seam — the live
+    lowering of ``Scenario.drop_pct``.  One instance is shared by every
+    node's transport (matching the deterministic engine's single drop
+    mangler); the RNG is locked because each transport calls ``on_send``
+    from its own serializer/consumer threads."""
+
+    def __init__(self, drop_pct: int, seed: int):
+        self.drop_pct = drop_pct
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def on_send(self, peer_id: int, frame: bytes) -> bool:
+        if self.drop_pct <= 0:
+            return True
+        with self._lock:
+            return self._rng.random() * 100.0 >= self.drop_pct
+
+
+class PartitionProxy:
+    """A directed socket-level forwarder for one transport edge.
+
+    Node A is told peer B lives at this proxy's address; each accepted
+    connection dials the real upstream and two pump threads shuttle
+    bytes.  While cut, the listener is *closed*: the dialing side's
+    sender thread sees ECONNREFUSED and walks its reconnect backoff —
+    exactly what a firewalled peer produces.  Healing re-binds the same
+    port, so addresses registered via ``transport.connect`` stay valid
+    across any number of cut/heal cycles and node restarts."""
+
+    def __init__(self, upstream: tuple):
+        self.upstream = tuple(upstream)
+        self.cut_count = 0
+        self._lock = threading.Lock()
+        self._cut = False
+        self._closed = False
+        self._pipes: set = set()
+        self._threads: list = []
+        self._server = None
+        self._accept_thread = None
+        self._open_listener(("127.0.0.1", 0))
+        self.address = self._server.getsockname()
+
+    def _open_listener(self, address) -> None:
+        server = socket.create_server(address)
+        thread = threading.Thread(
+            target=self._accept_loop,
+            args=(server,),
+            name="chaos-proxy-accept",
+            daemon=True,
+        )
+        self._server = server
+        self._accept_thread = thread
+        thread.start()
+
+    def set_cut(self, cut: bool) -> None:
+        with self._lock:
+            if self._closed or cut == self._cut:
+                return
+            self._cut = cut
+            pipes = list(self._pipes) if cut else []
+        if cut:
+            self.cut_count += 1
+            self._close_listener()
+            for pipe in pipes:
+                _shutdown_close(pipe)
+        else:
+            # SO_REUSEADDR (create_server default) makes the same-port
+            # re-bind immediate; retry briefly for scheduler races.
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    self._open_listener(self.address)
+                    return
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.02)
+
+    def _close_listener(self) -> None:
+        server, thread = self._server, self._accept_thread
+        if server is None:
+            return
+        try:
+            server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        server.close()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)
+
+    def _accept_loop(self, server) -> None:
+        while True:
+            try:
+                conn, _addr = server.accept()
+            except OSError:
+                return  # listener closed (cut or shutdown)
+            with self._lock:
+                stale = self._closed or self._cut or self._server is not server
+            if stale:
+                _shutdown_close(conn)
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=2.0)
+            except OSError:
+                _shutdown_close(conn)
+                continue
+            with self._lock:
+                if self._closed or self._cut or self._server is not server:
+                    _shutdown_close(conn)
+                    _shutdown_close(up)
+                    continue
+                self._pipes.add(conn)
+                self._pipes.add(up)
+                pumps = [
+                    threading.Thread(
+                        target=self._pump,
+                        args=(conn, up),
+                        name="chaos-proxy-pump",
+                        daemon=True,
+                    ),
+                    threading.Thread(
+                        target=self._pump,
+                        args=(up, conn),
+                        name="chaos-proxy-pump",
+                        daemon=True,
+                    ),
+                ]
+                self._threads.extend(pumps)
+            for pump in pumps:
+                pump.start()
+
+    def _pump(self, src, dst) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                self._pipes.discard(src)
+                self._pipes.discard(dst)
+            _shutdown_close(src)
+            _shutdown_close(dst)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pipes = list(self._pipes)
+            threads = list(self._threads)
+        self._close_listener()
+        for pipe in pipes:
+            _shutdown_close(pipe)
+        for thread in threads:
+            thread.join(timeout=5)
+
+
+class DurableChainLog(Log):
+    """The runtime application under chaos: a hash-chain Log whose every
+    apply is fsynced to an append-only JSONL file — the live analogue of
+    the testengine's per-node NodeState evidence, and the ground truth
+    for the no-fork / durable-prefix audits.
+
+    WAL replay after a restart re-delivers committed entries; applies at
+    or below the last durable seq_no are skipped, so the on-disk log (and
+    the exactly-once audit reading it) never records a replay twice.
+    State-transfer adoption is its own record kind: the chain jumps, and
+    the skipped range stays absent (adopted, not individually committed).
+    """
+
+    def __init__(self, path: str, node_id: int, on_commit=None):
+        self.path = path
+        self.node_id = node_id
+        self.on_commit = on_commit
+        self.chain = b""
+        self.commits: list = []  # [(client_id, req_no, seq_no)]
+        self.last_seq = 0
+        if os.path.exists(path):
+            self._load()
+        self._file = open(path, "ab")
+
+    def _load(self) -> None:
+        with open(self.path, "rb") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail write from a crash: ignore it
+                self.chain = bytes.fromhex(rec["chain"])
+                self.last_seq = rec["seq"]
+                if rec["t"] == "apply":
+                    for client_id, req_no, _digest in rec["reqs"]:
+                        self.commits.append((client_id, req_no, rec["seq"]))
+
+    def _record(self, rec: dict) -> None:
+        self._file.write(json.dumps(rec).encode() + b"\n")
+        self._file.flush()
+        os.fsync(self._file.fileno())
+
+    def apply(self, q_entry: pb.QEntry) -> None:
+        if q_entry.seq_no <= self.last_seq:
+            return  # WAL replay of an already-durable entry
+        reqs = []
+        for ack in q_entry.requests:
+            h = hashlib.sha256()
+            h.update(self.chain)
+            h.update(ack.digest)
+            self.chain = h.digest()
+            self.commits.append((ack.client_id, ack.req_no, q_entry.seq_no))
+            reqs.append((ack.client_id, ack.req_no, ack.digest.hex()))
+        self.last_seq = q_entry.seq_no
+        self._record(
+            {
+                "t": "apply",
+                "seq": q_entry.seq_no,
+                "reqs": reqs,
+                "chain": self.chain.hex(),
+            }
+        )
+        if reqs and self.on_commit is not None:
+            self.on_commit(self.node_id, len(reqs))
+
+    def adopt(self, value: bytes, seq_no: int) -> None:
+        """State transfer: adopt a peer's checkpointed app state."""
+        self.chain = value
+        if seq_no > self.last_seq:
+            self.last_seq = seq_no
+        self._record({"t": "adopt", "seq": seq_no, "chain": value.hex()})
+
+    def snap(self, network_config, clients_state) -> bytes:
+        return self.chain
+
+    def close(self) -> None:
+        self._file.close()
+
+    def crash(self) -> None:
+        # Every apply already fsynced, so a crash loses nothing here; the
+        # distinction matters for the WAL/reqstore, whose sync cadence is
+        # the runtime's.
+        self._file.close()
+
+
+class LiveReplica:
+    """One real node: serializer (inside Node), consumer loop thread,
+    TCP transport wired through the cluster's partition proxies, and
+    on-disk WAL/reqstore/app-log under the cluster's scratch root."""
+
+    def __init__(self, cluster, node_id: int, initial_state=None, port=0):
+        self.cluster = cluster
+        self.node_id = node_id
+        self.dir = os.path.join(cluster.root, f"node{node_id}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.app_log = DurableChainLog(
+            os.path.join(self.dir, "app.log"),
+            node_id,
+            on_commit=cluster._on_commit,
+        )
+        self.wal = FileWal(os.path.join(self.dir, "wal"))
+        self.reqstore = FileRequestStore(os.path.join(self.dir, "reqs"))
+        config = Config(id=node_id, batch_size=cluster.scenario.batch_size)
+        if initial_state is not None:
+            self.node = Node.start_new(config, initial_state)
+        else:
+            self.node = Node.restart(config, self.wal, self.reqstore)
+        self.transport = self._bind(port)
+        self.port = self.transport.address[1]
+        if cluster.drop_fault is not None:
+            self.transport.fault = cluster.drop_fault
+        self.transport.serve(self.node)
+        self.processor = SerialProcessor(
+            self.node,
+            self.transport.link(),
+            self.app_log,
+            self.wal,
+            self.reqstore,
+        )
+        # seq_no -> (value, pb.NetworkState): serves peers' state
+        # transfers out of band (the consumer's job in the reference).
+        self.checkpoints: dict = {}
+        self.failed = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._consume,
+            name=f"live-consumer-{node_id}",
+            daemon=True,
+        )
+
+    def _bind(self, port: int) -> TcpTransport:
+        """Bind the transport; a restart re-binds the node's original
+        port (retrying through TIME_WAIT) so the partition proxies'
+        upstream addresses stay valid across the reboot."""
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                return TcpTransport(
+                    self.node_id,
+                    port=port,
+                    backoff_base=0.02,
+                    backoff_cap=0.25,
+                    dial_timeout=1.0,
+                )
+            except OSError:
+                if port == 0 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.02)
+
+    def wire(self) -> None:
+        for peer_id in range(self.cluster.scenario.node_count):
+            if peer_id != self.node_id:
+                proxy = self.cluster.proxies[(self.node_id, peer_id)]
+                self.transport.connect(peer_id, proxy.address)
+
+    def start_consumer(self) -> None:
+        self._thread.start()
+
+    def arm_storage_fault(self) -> None:
+        def fail() -> None:
+            raise OSError("injected fsync failure (chaos StorageFault)")
+
+        self.wal.fault_hook = fail
+        self.reqstore.fault_hook = fail
+
+    def _consume(self) -> None:
+        tick_seconds = self.cluster.tick_seconds
+        last_tick = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                actions = self.node.ready(timeout=0.01)
+                if actions is not None:
+                    results = self.processor.process(actions)
+                    for cr in results.checkpoints:
+                        self.checkpoints[cr.checkpoint.seq_no] = (
+                            cr.value,
+                            pb.NetworkState(
+                                config=cr.checkpoint.network_config,
+                                clients=cr.checkpoint.clients_state,
+                                pending_reconfigurations=list(
+                                    cr.reconfigurations
+                                ),
+                            ),
+                        )
+                    if results.digests or results.checkpoints:
+                        self.node.add_results(results)
+                now = time.monotonic()
+                if now - last_tick >= tick_seconds:
+                    last_tick = now
+                    self.node.tick()
+                if actions is not None and actions.state_transfer is not None:
+                    self._serve_transfer(actions.state_transfer)
+        except NodeStopped:
+            pass
+        except Exception as err:  # noqa: BLE001 — injected faults land here
+            self.failed = err
+
+    def _serve_transfer(self, target) -> None:
+        for peer in self.cluster.alive_replicas():
+            if peer is self:
+                continue
+            entry = peer.checkpoints.get(target.seq_no)
+            if entry is None or entry[0] != target.value:
+                continue
+            value, network_state = entry
+            self.app_log.adopt(value, target.seq_no)
+            self.node.state_transfer_complete(target, network_state)
+            return
+        self.node.state_transfer_failed(target)
+
+    def snapshot(self, at_ms: int) -> CrashSnapshot:
+        return CrashSnapshot(
+            node=self.node_id, at_ms=at_ms, committed=list(self.app_log.commits)
+        )
+
+    def kill(self, graceful: bool = False) -> None:
+        """Tear the replica down.  ``graceful=False`` models kill -9 as
+        closely as an in-process harness can: storage handles close
+        without their shutdown fsync, so only what the runtime already
+        synced is durable."""
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=10)
+        self.transport.close(0)
+        self.node.stop()
+        if graceful:
+            self.wal.close()
+            self.reqstore.close()
+            self.app_log.close()
+        else:
+            self.wal.crash()
+            self.reqstore.crash()
+            self.app_log.crash()
+
+
+class LiveCluster:
+    """The driver: boots N replicas behind partition proxies, runs the
+    paced client load, fires the scenario's fault schedule at scaled
+    wall-clock instants, and reports convergence evidence."""
+
+    def __init__(
+        self,
+        scenario: Scenario,
+        seed: int,
+        tick_seconds: float,
+        budget_s: float,
+        max_reqs_per_client: int,
+    ):
+        self.scenario = scenario
+        self.seed = seed
+        self.tick_seconds = tick_seconds
+        self.budget_s = budget_s
+        # Live runs pay real fsyncs per commit; the deterministic matrix's
+        # larger request counts (sized for client-window coverage) are
+        # clamped so each scenario stays inside its wall-clock budget.
+        self.reqs_per_client = min(scenario.reqs_per_client, max_reqs_per_client)
+        self.clients = list(range(1, scenario.client_count + 1))
+        self.root = tempfile.mkdtemp(prefix=f"mirbft-live-{scenario.name}-")
+        self.replicas: list = [None] * scenario.node_count
+        self.ports = [0] * scenario.node_count
+        self.proxies: dict = {}  # (src, dst) -> PartitionProxy
+        self.drop_fault = (
+            DropFault(scenario.drop_pct, seed) if scenario.drop_pct else None
+        )
+        self._lock = threading.Lock()
+        self.commit_times_ms: list = []
+        self.heal_times_ms: list = []
+        self.snapshots: list = []
+        self.events_fired = 0
+        self.requests: dict = {}
+        self.signer = None
+        self.plane = None
+        self.forged_rejected = None
+        self._start = None
+        self._proposer_stop = threading.Event()
+        self._proposer = None
+        if scenario.signed:
+            from ..testengine.signing import SignaturePlane, make_signer
+
+            self.signer = make_signer()
+            self.plane = (
+                scenario.signature_plane()
+                if scenario.signature_plane
+                else SignaturePlane()
+            )
+
+    # -- time ----------------------------------------------------------------
+
+    def scale_s(self, sim_ms: int) -> float:
+        """Simulated ms (authored against the 500ms testengine tick) to
+        wall seconds under this cluster's real tick period."""
+        return sim_ms / SIM_TICK_MS * self.tick_seconds
+
+    def now_ms(self) -> int:
+        return int((time.monotonic() - self._start) * 1000)
+
+    def _on_commit(self, _node_id: int, _nreqs: int) -> None:
+        with self._lock:
+            self.commit_times_ms.append(self.now_ms())
+
+    # -- topology ------------------------------------------------------------
+
+    def alive_replicas(self) -> list:
+        return [r for r in self.replicas if r is not None]
+
+    def boot(self) -> None:
+        state = standard_initial_network_state(
+            self.scenario.node_count, self.clients
+        )
+        for n in range(self.scenario.node_count):
+            self.replicas[n] = LiveReplica(self, n, initial_state=state)
+            self.ports[n] = self.replicas[n].port
+        for a in range(self.scenario.node_count):
+            for b in range(self.scenario.node_count):
+                if a != b:
+                    self.proxies[(a, b)] = PartitionProxy(
+                        self.replicas[b].transport.address
+                    )
+        for replica in self.replicas:
+            replica.wire()
+            replica.start_consumer()
+
+    def _edges_across(self, groups):
+        group_of = {}
+        for gi, group in enumerate(groups):
+            for node in group:
+                group_of[node] = gi
+        for a in range(self.scenario.node_count):
+            for b in range(self.scenario.node_count):
+                if a != b and group_of.get(a) != group_of.get(b):
+                    yield (a, b)
+
+    def _set_partition(self, groups, cut: bool) -> None:
+        for edge in self._edges_across(groups):
+            self.proxies[edge].set_cut(cut)
+
+    def _crash(self, node: int) -> None:
+        replica = self.replicas[node]
+        if replica is None:
+            return
+        self.snapshots.append(replica.snapshot(self.now_ms()))
+        self.replicas[node] = None
+        replica.kill()
+
+    def _restart(self, node: int) -> None:
+        if self.replicas[node] is not None:
+            # A storage-fault victim whose fsync never fired (no persist
+            # traffic): force the kill so the reboot still exercises
+            # restart-from-disk.
+            self._crash(node)
+        replica = LiveReplica(self, node, initial_state=None, port=self.ports[node])
+        replica.wire()
+        replica.start_consumer()
+        self.replicas[node] = replica
+        with self._lock:
+            self.heal_times_ms.append(self.now_ms())
+
+    # -- client load ---------------------------------------------------------
+
+    def start_proposer(self, last_event_s: float) -> None:
+        self._proposer = threading.Thread(
+            target=self._propose_all,
+            args=(last_event_s,),
+            name="chaos-live-proposer",
+            daemon=True,
+        )
+        self._proposer.start()
+
+    def _propose_all(self, last_event_s: float) -> None:
+        requests: dict = {}
+        for req_no in range(self.reqs_per_client):
+            for client_id in self.clients:
+                payload = b"%d" % req_no
+                data = (
+                    self.signer(client_id, req_no, payload)
+                    if self.signer is not None
+                    else payload
+                )
+                requests[(client_id, req_no)] = data
+        self.requests = requests
+        # Pace the initial pass past the last fault instant so every
+        # disruption lands mid-traffic AND a tail of fresh proposals
+        # arrives after the final heal — the commit-resumption invariant
+        # measures real post-heal progress, not leftovers.
+        span_s = max(last_event_s * 1.25, 0.4)
+        gap = span_s / max(len(requests), 1)
+        for (client_id, req_no), data in requests.items():
+            if self._proposer_stop.wait(gap):
+                return
+            if self.plane is not None and not self.plane.valid(
+                client_id, req_no, data
+            ):
+                continue  # ingress auth rejected (never for honest clients)
+            for replica in self.alive_replicas():
+                self._propose_one(replica, client_id, req_no, data)
+        if self.plane is not None:
+            # Ingress authentication must stop a forged request cold: the
+            # real payload with one signature byte flipped.
+            client_id = self.clients[0]
+            good = requests[(client_id, 0)]
+            forged = good[:-96] + bytes([good[-96] ^ 0xFF]) + good[-95:]
+            self.forged_rejected = not self.plane.valid(client_id, 0, forged)
+        # Client retry: keep nudging stragglers (restarted nodes, frames
+        # lost to drops/partitions) until the driver declares convergence.
+        # Re-proposing an already-committed req_no is safe: the ack
+        # filter drops below-watermark acks as PAST.
+        while not self._proposer_stop.wait(0.3):
+            for replica in self.alive_replicas():
+                committed = {(c, q) for c, q, _s in replica.app_log.commits}
+                for (client_id, req_no), data in requests.items():
+                    if (client_id, req_no) not in committed:
+                        self._propose_one(replica, client_id, req_no, data)
+
+    def _propose_one(self, replica, client_id, req_no, data) -> None:
+        try:
+            replica.node.propose(
+                pb.Request(client_id=client_id, req_no=req_no, data=data)
+            )
+        except (NodeStopped, ValueError):
+            pass  # node stopped/crashed concurrently: the retry pass covers it
+
+    # -- the drive loop ------------------------------------------------------
+
+    def schedule(self) -> list:
+        events = []
+        for window in self.scenario.partitions:
+            events.append((self.scale_s(window.from_ms), 0, "cut", window.groups))
+            events.append((self.scale_s(window.until_ms), 1, "heal", window.groups))
+        for point in self.scenario.crashes:
+            events.append((self.scale_s(point.at_ms), 2, "crash", point.node))
+            events.append(
+                (
+                    self.scale_s(point.at_ms + point.restart_delay_ms),
+                    3,
+                    "restart",
+                    point.node,
+                )
+            )
+        for fault in self.scenario.storage_faults:
+            events.append(
+                (self.scale_s(fault.at_ms), 4, "storage_fault", fault.node)
+            )
+            events.append(
+                (
+                    self.scale_s(fault.at_ms + fault.restart_delay_ms),
+                    5,
+                    "restart",
+                    fault.node,
+                )
+            )
+        events.sort(key=lambda e: (e[0], e[1]))
+        return events
+
+    def _fire(self, kind: str, payload, armed: set) -> None:
+        if kind == "cut":
+            self._set_partition(payload, True)
+        elif kind == "heal":
+            self._set_partition(payload, False)
+            with self._lock:
+                self.heal_times_ms.append(self.now_ms())
+        elif kind == "crash":
+            self._crash(payload)
+        elif kind == "storage_fault":
+            replica = self.replicas[payload]
+            if replica is not None:
+                replica.arm_storage_fault()
+                armed.add(payload)
+        elif kind == "restart":
+            self._restart(payload)
+
+    def _reap(self, armed: set) -> None:
+        """Crash-kill replicas whose consumer died on an injected storage
+        fault; any *uninjected* death is a real bug and fails the run."""
+        for n, replica in enumerate(self.replicas):
+            if replica is None:
+                continue
+            if replica.failed is not None:
+                if n in armed:
+                    self._crash(n)
+                else:
+                    raise InvariantViolation(
+                        f"node {n} consumer died without an injected fault: "
+                        f"{replica.failed!r}"
+                    )
+            elif replica.node.exit_error is not None:
+                raise InvariantViolation(
+                    f"node {n} serializer died: {replica.node.exit_error!r}"
+                )
+
+    def _converged(self, expected: set) -> bool:
+        """The TCP-tier convergence criterion: every node is up, at least
+        one committed the full request set, and all app chains agree (a
+        restarted node may have adopted part of the history via state
+        transfer rather than committing it individually)."""
+        replicas = list(self.replicas)
+        if any(r is None for r in replicas):
+            return False
+        full = False
+        chains = set()
+        for replica in replicas:
+            pairs = {(c, q) for c, q, _s in replica.app_log.commits}
+            if expected <= pairs:
+                full = True
+            chains.add(replica.app_log.chain)
+        return full and len(chains) == 1 and b"" not in chains
+
+    def run(self) -> int:
+        """Boot, drive the schedule, and return the convergence instant
+        (wall ms since start); raises InvariantViolation on timeout or an
+        uninjected node death."""
+        self._start = time.monotonic()
+        self.boot()
+        events = self.schedule()
+        last_event_s = events[-1][0] if events else 0.0
+        self.start_proposer(last_event_s)
+        expected = {
+            (client_id, req_no)
+            for client_id in self.clients
+            for req_no in range(self.reqs_per_client)
+        }
+        deadline = self._start + self.budget_s
+        armed: set = set()
+        while time.monotonic() < deadline:
+            now_s = time.monotonic() - self._start
+            while events and events[0][0] <= now_s:
+                _at, _order, kind, payload = events.pop(0)
+                self.events_fired += 1
+                self._fire(kind, payload, armed)
+            self._reap(armed)
+            if not events and self._converged(expected):
+                return self.now_ms()
+            time.sleep(0.01)
+        commits = [
+            len(r.app_log.commits) if r is not None else None
+            for r in self.replicas
+        ]
+        raise InvariantViolation(
+            f"no convergence within the {self.budget_s:.0f}s budget "
+            f"(per-node commits: {commits}, epochs: {self._epoch_states()}, "
+            f"events unfired: {len(events)})"
+        )
+
+    def _epoch_states(self) -> list:
+        """Per-node ``epoch/state`` diagnostic strings for the timeout
+        report (a wedged epoch change reads very differently from a
+        transport-level stall)."""
+        states = []
+        for replica in self.replicas:
+            if replica is None:
+                states.append("down")
+                continue
+            try:
+                status = replica.node.status(timeout=2.0)
+            except NodeStopped:
+                status = None
+            if status is None or status.epoch_tracker is None:
+                states.append("?")
+            else:
+                et = status.epoch_tracker
+                states.append(f"{et.number}/{et.state}")
+        return states
+
+    def teardown(self) -> None:
+        self._proposer_stop.set()
+        if self._proposer is not None and self._proposer.ident is not None:
+            self._proposer.join(timeout=10)
+        for n, replica in enumerate(self.replicas):
+            if replica is not None:
+                self.replicas[n] = None
+                replica.kill(graceful=True)
+        for proxy in self.proxies.values():
+            proxy.close()
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+class _LiveEvidence:
+    """Adapter handing the shared invariant checkers (invariants.py) the
+    recorder-shaped view they audit, backed by the cluster's durable
+    per-node commit logs."""
+
+    def __init__(self, replicas: list):
+        self.node_count = len(replicas)
+        self.node_states = [
+            SimpleNamespace(
+                committed_reqs=list(replica.app_log.commits),
+                app_chain=replica.app_log.chain,
+                crashed=False,
+            )
+            for replica in replicas
+        ]
+
+
+def _epoch_active_total(registry) -> int:
+    """Count obsv ``epoch.active`` milestone events for epochs >= 1 (the
+    boot-time epoch 0 activation is excluded)."""
+    snap = registry.snapshot().get("mirbft_epoch_events_total")
+    if not snap:
+        return 0
+    total = 0
+    for series in snap["series"]:
+        labels = series["labels"]
+        if labels.get("event") == "active" and labels.get("epoch") != "0":
+            total += series["value"]
+    return int(total)
+
+
+def run_live_scenario(
+    scenario: Scenario,
+    seed: int = 0,
+    registry: Registry | None = None,
+    tick_seconds: float = 0.04,
+    budget_s: float = 90.0,
+    max_reqs_per_client: int = 40,
+) -> ScenarioResult:
+    """Execute one scenario against a real loopback cluster and audit
+    every invariant.  Invariant violations are reported in the result,
+    never raised; harness bugs propagate.
+
+    Observability is required (epoch milestones and transport counters
+    are part of the evidence): if hooks are not already enabled, they are
+    enabled around the run with ``registry`` (or a fresh one) and
+    restored after."""
+    own_hooks = not hooks.enabled
+    if own_hooks:
+        hooks.enable(
+            registry=registry if registry is not None else Registry(),
+            trace=False,
+        )
+    registry = hooks.metrics
+    result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
+    epoch_active_before = _epoch_active_total(registry)
+    cluster = LiveCluster(
+        scenario, seed, tick_seconds, budget_s, max_reqs_per_client
+    )
+    try:
+        try:
+            converged_ms = cluster.run()
+            heals = cluster.heal_times_ms
+            last_heal = max(heals) if heals else 0
+            bound_ms = max(
+                int(cluster.scale_s(scenario.recovery_bound_ms) * 1000),
+                MIN_RECOVERY_BOUND_MS,
+            )
+            gauge = registry.gauge(
+                "mirbft_chaos_live_recovery_ms", scenario=scenario.name
+            )
+            gauge.set(converged_ms - last_heal)
+            result.counters["recovery_ms"] = gauge.value
+            check_bounded_recovery(converged_ms, last_heal, bound_ms)
+            if heals:
+                check_commit_resumption(
+                    cluster.commit_times_ms, last_heal, bound_ms
+                )
+            evidence = _LiveEvidence(cluster.replicas)
+            check_no_fork(evidence)
+            check_durable_prefix(evidence, cluster.snapshots)
+            if scenario.expect_epoch_change:
+                delta = _epoch_active_total(registry) - epoch_active_before
+                result.counters["epoch_active_events"] = delta
+                if delta <= 0:
+                    raise InvariantViolation(
+                        "scenario expected an epoch change but the obsv "
+                        "epoch.active milestone never fired for epoch >= 1"
+                    )
+                epochs = []
+                for replica in cluster.alive_replicas():
+                    status = replica.node.status(timeout=5.0)
+                    if status is not None and status.epoch_tracker is not None:
+                        epochs.append(status.epoch_tracker.number)
+                result.counters["epoch"] = max(epochs) if epochs else 0
+                if not epochs or max(epochs) < 1:
+                    raise InvariantViolation(
+                        "scenario expected an epoch change but every node "
+                        "reports epoch 0"
+                    )
+            if cluster.plane is not None:
+                result.counters["sig_device_errors"] = (
+                    cluster.plane.device_errors
+                )
+                result.counters["sig_fallbacks"] = (
+                    cluster.plane.fallback_verifies
+                )
+                result.counters["sig_breaker"] = cluster.plane.breaker.state
+                if cluster.forged_rejected is not True:
+                    raise InvariantViolation(
+                        "a forged request passed ingress signature "
+                        "verification"
+                    )
+            result.passed = True
+        except InvariantViolation as violation:
+            result.violation = str(violation)
+        result.events = cluster.events_fired
+        result.sim_ms = cluster.now_ms() if cluster._start is not None else 0
+        result.commits = sum(
+            len(replica.app_log.commits)
+            for replica in cluster.alive_replicas()
+        )
+        if cluster.snapshots:
+            result.counters["crashes"] = len(cluster.snapshots)
+        tcp = {"connects": 0, "connect_failures": 0, "send_failures": 0}
+        dropped_fault = 0
+        for replica in cluster.alive_replicas():
+            counters = replica.transport.counters()
+            dropped_fault += counters["dropped_fault"]
+            for peer in counters["peers"].values():
+                tcp["connects"] += peer["connects"]
+                tcp["connect_failures"] += peer["connect_failures"]
+                tcp["send_failures"] += peer["send_failures"]
+        result.counters["tcp_connects"] = tcp["connects"]
+        if tcp["connect_failures"]:
+            result.counters["tcp_connect_failures"] = tcp["connect_failures"]
+        if tcp["send_failures"]:
+            result.counters["tcp_send_failures"] = tcp["send_failures"]
+        if dropped_fault:
+            result.counters["dropped_fault"] = dropped_fault
+    finally:
+        cluster.teardown()
+        if own_hooks:
+            hooks.disable()
+    return result
+
+
+def run_live_campaign(
+    scenarios: list | None = None,
+    seed: int = 0,
+    tick_seconds: float = 0.04,
+    budget_s: float = 90.0,
+) -> CampaignResult:
+    """Run a scenario list (default: the live matrix) against real
+    clusters, one at a time, under derived per-scenario seeds."""
+    if scenarios is None:
+        scenarios = live_matrix()
+    campaign = CampaignResult(seed=seed)
+    for index, scenario in enumerate(scenarios):
+        campaign.results.append(
+            run_live_scenario(
+                scenario,
+                seed=seed + index,
+                tick_seconds=tick_seconds,
+                budget_s=budget_s,
+            )
+        )
+    return campaign

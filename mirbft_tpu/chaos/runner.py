@@ -17,6 +17,7 @@ from .invariants import (
     CrashSnapshot,
     InvariantViolation,
     check_bounded_recovery,
+    check_commit_resumption,
     check_durable_prefix,
     check_full_convergence,
     check_no_fork,
@@ -79,8 +80,19 @@ def run_scenario(
     registry, else a throwaway local one."""
     if registry is None:
         registry = hooks.metrics if hooks.enabled else Registry()
-    manglers = scenario.manglers() if scenario.manglers else []
+    manglers = scenario.build_manglers()
     hash_plane = scenario.hash_plane() if scenario.hash_plane else None
+    signer = None
+    signature_plane = None
+    if scenario.signed:
+        from ..testengine.signing import SignaturePlane, make_signer
+
+        signer = make_signer()
+        signature_plane = (
+            scenario.signature_plane()
+            if scenario.signature_plane
+            else SignaturePlane()
+        )
     rec = BasicRecorder(
         node_count=scenario.node_count,
         client_count=scenario.client_count,
@@ -89,6 +101,8 @@ def run_scenario(
         seed=seed,
         manglers=manglers,
         hash_plane=hash_plane,
+        signer=signer,
+        signature_plane=signature_plane,
         record=False,
     )
 
@@ -162,6 +176,21 @@ def run_scenario(
             last_disruption_end_ms=max(ends) if ends else 0,
             bound_ms=scenario.recovery_bound_ms,
         )
+        if ends:
+            check_commit_resumption(
+                commit_times, max(ends), scenario.recovery_bound_ms
+            )
+        if scenario.expect_epoch_change:
+            epochs = [
+                rec.machines[n].epoch_tracker.current_epoch.number
+                for n in range(rec.node_count)
+            ]
+            result.counters["epoch"] = max(epochs)
+            if max(epochs) < 1:
+                raise InvariantViolation(
+                    "scenario expected an epoch change but every node "
+                    "is still in epoch 0"
+                )
         result.passed = True
     except InvariantViolation as violation:
         result.violation = str(violation)
@@ -197,6 +226,10 @@ def run_scenario(
         result.counters["fallback_digests"] = hash_plane.fallback_digests
         result.counters["breaker"] = hash_plane.breaker.state
         result.counters["breaker_trips"] = hash_plane.breaker.trips
+    if signature_plane is not None:
+        result.counters["sig_device_errors"] = signature_plane.device_errors
+        result.counters["sig_fallbacks"] = signature_plane.fallback_verifies
+        result.counters["sig_breaker"] = signature_plane.breaker.state
     return result
 
 

@@ -1,13 +1,23 @@
-"""The chaos scenario matrix.
+"""The chaos scenario matrix: one schema, two engines.
 
 Each ``Scenario`` is declarative: factories (not instances) for manglers
 and crypto planes, because both are stateful per run — the runner builds
 fresh ones for every (scenario, seed) execution so campaigns are
 reproducible and scenarios can repeat across seeds.
 
+The structured fault fields — ``partitions`` (PartitionWindow),
+``crashes`` (CrashPoint), ``drop_pct``, ``storage_faults``
+(StorageFault), ``signed`` — are engine-agnostic: the deterministic
+runner (runner.py) lowers them onto testengine manglers and simulated
+crash schedules, while the live driver (live.py) lowers the *same*
+scenario onto socket-level partition proxies, real crash-kills of
+runtime Nodes, transport-seam message loss, and failing fsyncs.  Only
+``manglers`` (the raw mangler-DSL escape hatch) is testengine-specific.
+
 The matrix mirrors the reference's fault suite (mirbft_test.go:68-222)
-and extends it with network partitions (with heal) and device-plane
-faults against the coalescing crypto planes."""
+and extends it with network partitions (with heal), device-plane faults
+against the coalescing crypto planes, epoch-change-targeted leader
+isolation, and signed-mode verifier faults."""
 
 from __future__ import annotations
 
@@ -23,7 +33,8 @@ from ..testengine.manglers import (
     percent,
     rule,
 )
-from .faults import FlakyDigestBackend
+from ..testengine.signing import SignaturePlane
+from .faults import FlakyDigestBackend, FlakyVerifierBackend
 
 
 @dataclass(frozen=True)
@@ -31,6 +42,30 @@ class CrashPoint:
     """Runner-driven crash: at ``at_ms`` simulated time, crash ``node``
     (snapshotting its durable commit log for the durability invariant)
     and reboot it from durable state ``restart_delay_ms`` later."""
+
+    at_ms: int
+    node: int
+    restart_delay_ms: int
+
+
+@dataclass(frozen=True)
+class PartitionWindow:
+    """Declarative network split: messages crossing between ``groups``
+    are cut for ``from_ms <= t < until_ms``, then the network heals.
+    The deterministic runner lowers this onto the partition() mangler;
+    the live driver cuts the socket-level partition proxies."""
+
+    groups: tuple  # tuple of tuples of node ids, covering all nodes
+    from_ms: int
+    until_ms: int
+
+
+@dataclass(frozen=True)
+class StorageFault:
+    """Live-only fault: from ``at_ms`` the node's WAL/reqstore fsyncs
+    raise OSError, so the runtime's persist path fails loudly; the
+    driver crash-kills the node and reboots it — with healthy storage —
+    ``restart_delay_ms`` after the fault hit."""
 
     at_ms: int
     node: int
@@ -47,12 +82,25 @@ class Scenario:
     reqs_per_client: int = 10
     batch_size: int = 1
     # Zero-arg factory -> list of manglers (fresh state per run).
+    # Testengine-only: prefer the structured fields below, which both
+    # engines understand.
     manglers: object = None
     crashes: tuple = ()  # CrashPoints, fired by the runner
+    partitions: tuple = ()  # PartitionWindows (both engines)
+    drop_pct: int = 0  # uniform message-loss percentage (both engines)
+    storage_faults: tuple = ()  # StorageFaults (live driver only)
+    # Signed-request mode: clients Ed25519-sign, replicas verify at
+    # ingress through a SignaturePlane (factory below, fresh per run).
+    signed: bool = False
+    signature_plane: object = None  # zero-arg factory (signed mode)
+    # The scenario is designed to force an epoch change; the runner
+    # fails it unless every surviving node ends in an epoch >= 1.
+    expect_epoch_change: bool = False
     # Zero-arg factory -> hash plane (fresh breaker/counters per run).
     hash_plane: object = None
-    # Heal instants (ms) of disruptions the manglers inject (partition
-    # until_ms etc.); restarts from ``crashes`` are added automatically.
+    # Heal instants (ms) of disruptions the raw manglers inject;
+    # structured faults (partitions/crashes/storage) are added
+    # automatically by disruption_ends().
     heal_points_ms: tuple = ()
     recovery_bound_ms: int = 120_000
     max_steps: int = 600_000
@@ -60,8 +108,29 @@ class Scenario:
 
     def disruption_ends(self) -> list:
         ends = list(self.heal_points_ms)
+        ends.extend(w.until_ms for w in self.partitions)
         ends.extend(c.at_ms + c.restart_delay_ms for c in self.crashes)
+        ends.extend(s.at_ms + s.restart_delay_ms for s in self.storage_faults)
         return ends
+
+    def build_manglers(self) -> list:
+        """Lower the structured fault fields onto testengine manglers
+        (plus any raw ``manglers`` the scenario carries).  Fresh mangler
+        state per call, so runs stay independent."""
+        built = []
+        for window in self.partitions:
+            built.append(
+                partition(
+                    [list(group) for group in window.groups],
+                    from_ms=window.from_ms,
+                    until_ms=window.until_ms,
+                )
+            )
+        if self.drop_pct:
+            built.append(rule(is_step(), percent(self.drop_pct)).drop())
+        if self.manglers:
+            built.extend(self.manglers())
+        return built
 
 
 def _flaky_plane(mode: str, **kwargs):
@@ -79,6 +148,21 @@ def _flaky_plane(mode: str, **kwargs):
             digest_many=FlakyDigestBackend(mode=mode, **kwargs),
             breaker=CircuitBreaker(failure_threshold=1, probe_interval=1),
             timeout_s=0.0005 if mode == "slow" else None,
+        )
+
+    return build
+
+
+def _flaky_signature_plane(**kwargs):
+    """Factory-factory: a SignaturePlane whose verifier backend
+    misbehaves for a call window, guarded by the same hair-trigger
+    breaker as _flaky_plane so the trip → fallback → probe → re-close
+    cycle is walked deterministically."""
+
+    def build():
+        return SignaturePlane(
+            verifier=FlakyVerifierBackend(**kwargs),
+            breaker=CircuitBreaker(failure_threshold=1, probe_interval=1),
         )
 
     return build
@@ -110,7 +194,7 @@ def matrix() -> list:
         Scenario(
             name="drop-10pct",
             description="10% uniform message loss",
-            manglers=lambda: [rule(is_step(), percent(10)).drop()],
+            drop_pct=10,
         ),
         Scenario(
             name="ack-loss-70pct",
@@ -123,27 +207,32 @@ def matrix() -> list:
         Scenario(
             name="partition-minority",
             description="node 0 isolated 2s..12s, then heals",
-            manglers=lambda: [
-                partition([[0], [1, 2, 3]], from_ms=2000, until_ms=12_000)
-            ],
-            heal_points_ms=(12_000,),
+            partitions=(
+                PartitionWindow(
+                    groups=((0,), (1, 2, 3)), from_ms=2000, until_ms=12_000
+                ),
+            ),
         ),
         Scenario(
             name="partition-split-2-2",
             description="2-2 split (no quorum anywhere) 2s..10s, then heals",
-            manglers=lambda: [
-                partition([[0, 1], [2, 3]], from_ms=2000, until_ms=10_000)
-            ],
-            heal_points_ms=(10_000,),
+            partitions=(
+                PartitionWindow(
+                    groups=((0, 1), (2, 3)), from_ms=2000, until_ms=10_000
+                ),
+            ),
         ),
         Scenario(
             name="partition-flapping",
             description="node 3 isolated twice: 2s..6s and 9s..13s",
-            manglers=lambda: [
-                partition([[3], [0, 1, 2]], from_ms=2000, until_ms=6000),
-                partition([[3], [0, 1, 2]], from_ms=9000, until_ms=13_000),
-            ],
-            heal_points_ms=(6000, 13_000),
+            partitions=(
+                PartitionWindow(
+                    groups=((3,), (0, 1, 2)), from_ms=2000, until_ms=6000
+                ),
+                PartitionWindow(
+                    groups=((3,), (0, 1, 2)), from_ms=9000, until_ms=13_000
+                ),
+            ),
         ),
         Scenario(
             name="crash-restart",
@@ -184,20 +273,49 @@ def matrix() -> list:
             name="partition-plus-crash",
             description="node 0 isolated 2s..10s while node 2 crashes at "
             "4s and reboots at 9s",
-            manglers=lambda: [
-                partition([[0], [1, 2, 3]], from_ms=2000, until_ms=10_000)
-            ],
+            partitions=(
+                PartitionWindow(
+                    groups=((0,), (1, 2, 3)), from_ms=2000, until_ms=10_000
+                ),
+            ),
             crashes=(CrashPoint(at_ms=4000, node=2, restart_delay_ms=5000),),
-            heal_points_ms=(10_000,),
         ),
         Scenario(
             name="partition-plus-duplication",
             description="2-2 split 2s..8s under 50% duplication",
-            manglers=lambda: [
-                partition([[0, 1], [2, 3]], from_ms=2000, until_ms=8000),
-                rule(is_step(), percent(50)).duplicate(300),
-            ],
-            heal_points_ms=(8000,),
+            partitions=(
+                PartitionWindow(
+                    groups=((0, 1), (2, 3)), from_ms=2000, until_ms=8000
+                ),
+            ),
+            manglers=lambda: [rule(is_step(), percent(50)).duplicate(300)],
+        ),
+        Scenario(
+            name="leader-isolation-epoch-change",
+            description="node 0 (a leader) isolated 2s..20s under 5% loss "
+            "— held far past the suspect timeout, so the survivors must "
+            "change epochs and commit the suspect's in-flight sequences "
+            "exactly once",
+            partitions=(
+                PartitionWindow(
+                    groups=((0,), (1, 2, 3)), from_ms=2000, until_ms=20_000
+                ),
+            ),
+            drop_pct=5,
+            expect_epoch_change=True,
+            tags=("epoch", "live"),
+        ),
+        Scenario(
+            name="signed-verifier-dies",
+            description="signed mode: the signature device raises "
+            "mid-run; breaker trips to the host oracle, then a probe "
+            "re-closes it",
+            signed=True,
+            signature_plane=_flaky_signature_plane(fail_from=1, fail_until=2),
+            # Past the client window width (100), so the lazy plane sees
+            # multiple flushes — the failure window [1, 3) is reachable.
+            reqs_per_client=120,
+            tags=("device", "signed", "live"),
         ),
     ]
 
@@ -210,3 +328,37 @@ SMOKE_NAMES = ("partition-minority", "crash-restart", "device-digest-dies")
 def smoke_matrix() -> list:
     by_name = {s.name: s for s in matrix()}
     return [by_name[name] for name in SMOKE_NAMES]
+
+
+def live_matrix() -> list:
+    """The live-cluster campaign (chaos/live.py): the shared structured
+    scenarios from the deterministic matrix, plus the one fault family
+    only a real runtime can express (failing fsyncs)."""
+    by_name = {s.name: s for s in matrix()}
+    return [
+        by_name["crash-restart"],
+        by_name["partition-minority"],
+        by_name["drop-10pct"],
+        by_name["leader-isolation-epoch-change"],
+        by_name["signed-verifier-dies"],
+        Scenario(
+            name="fsync-dies-restart",
+            description="node 2's disk starts failing fsyncs at 3s; the "
+            "runtime fails loudly, is crash-killed, and reboots with a "
+            "healthy disk 4s later (live only)",
+            storage_faults=(
+                StorageFault(at_ms=3000, node=2, restart_delay_ms=4000),
+            ),
+            tags=("storage", "live"),
+        ),
+    ]
+
+
+# The tier-1 live smoke: one crash+restart, one partition+heal — real
+# sockets and fsyncs under a hard wall-clock budget.
+LIVE_SMOKE_NAMES = ("crash-restart", "partition-minority")
+
+
+def live_smoke_matrix() -> list:
+    by_name = {s.name: s for s in live_matrix()}
+    return [by_name[name] for name in LIVE_SMOKE_NAMES]

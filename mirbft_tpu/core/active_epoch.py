@@ -267,9 +267,13 @@ class ActiveEpoch:
 
     def apply_commit_msg(self, source: int, seq_no: int, digest: bytes) -> Actions:
         seq = self.sequence(seq_no)
-        seq.apply_commit_msg(source, digest)
+        # The commit can be the very event that advances a lagging sequence
+        # through its prepare transitions (real transports deliver peers'
+        # commits while we are still preparing), and those transitions emit
+        # persists and sends — dropping them skips WAL indices.
+        actions = seq.apply_commit_msg(source, digest)
         if seq.state != SeqState.COMMITTED or seq_no != self.lowest_uncommitted:
-            return Actions()
+            return actions
 
         while self.lowest_uncommitted <= self.high_watermark():
             seq = self.sequence(self.lowest_uncommitted)
@@ -277,7 +281,7 @@ class ActiveEpoch:
                 break
             self.commit_state.commit(seq.q_entry)
             self.lowest_uncommitted += 1
-        return Actions()
+        return actions
 
     def apply_batch_hash_result(self, seq_no: int, digest: bytes) -> Actions:
         if not self.in_watermarks(seq_no):

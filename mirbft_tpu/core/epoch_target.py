@@ -26,6 +26,7 @@ from __future__ import annotations
 import enum
 
 from .. import pb
+from ..obsv import hooks
 from .actions import Actions
 from .active_epoch import ActiveEpoch
 from .batch_tracker import BatchTracker
@@ -244,6 +245,35 @@ class EpochTarget:
     # -- new epoch verification / fetch --------------------------------------
 
     def apply_new_epoch_msg(self, msg: pb.NewEpoch) -> Actions:
+        if (
+            self.leader_new_epoch is not None
+            and self.state < TargetState.ENDING
+            and pb.encode(msg.new_config)
+            == pb.encode(self.leader_new_epoch.new_config)
+        ):
+            # A retransmitted NewEpoch means the leader is still stuck
+            # short of its echo/ready quorum — some votes were lost on
+            # the wire.  Re-send ours (the vote tables dedup by source),
+            # closing the Bracha exchange's retransmission loop: the
+            # leader re-broadcasts its proposal on a tick cadence, and
+            # every recipient re-responds here.  Without this, a single
+            # dropped NewEpochReady can wedge the change forever: the
+            # epoch leader never suspects its own epoch, so a stuck
+            # leader plus a prepending laggard leaves the suspicion set
+            # one short of quorum.
+            actions = Actions()
+            config = self.leader_new_epoch.new_config
+            if self.state >= TargetState.ECHOING:
+                actions.send(
+                    self.network_config.nodes,
+                    pb.Msg(type=pb.NewEpochEcho(new_epoch_config=config)),
+                )
+            if self.state >= TargetState.READYING:
+                actions.send(
+                    self.network_config.nodes,
+                    pb.Msg(type=pb.NewEpochReady(new_epoch_config=config)),
+                )
+            return actions.concat(self.advance_state())
         self.leader_new_epoch = msg
         return self.advance_state()
 
@@ -367,16 +397,30 @@ class EpochTarget:
             else:
                 batch = self.batch_tracker.get_batch(digest)
                 if batch is None:
-                    raise AssertionError("batch vanished after fetch")
-                actions.concat(
-                    self.persisted.add_q_entry(
-                        pb.QEntry(
-                            seq_no=seq_no,
-                            digest=digest,
-                            requests=batch.request_acks,
+                    if seq_no > self.commit_state.highest_commit:
+                        raise AssertionError("batch vanished after fetch")
+                    # Already committed locally and pruned by checkpoint GC
+                    # (the fetch pass rightly skipped it, so it was never
+                    # re-fetched).  Persist the digest-only QEntry: the
+                    # epoch-change recomputation needs only (seq, digest),
+                    # and the ready-quorum replay skips sequences at or
+                    # below the low watermark while digest-matching any
+                    # still in the commit window.
+                    actions.concat(
+                        self.persisted.add_q_entry(
+                            pb.QEntry(seq_no=seq_no, digest=digest)
                         )
                     )
-                )
+                else:
+                    actions.concat(
+                        self.persisted.add_q_entry(
+                            pb.QEntry(
+                                seq_no=seq_no,
+                                digest=digest,
+                                requests=batch.request_acks,
+                            )
+                        )
+                    )
             if seq_no % ci == 0 and seq_no < self.commit_state.stop_at_seq_no:
                 actions.concat(
                     self.persisted.add_n_entry(
@@ -534,6 +578,10 @@ class EpochTarget:
                 )
                 actions.concat(self.active_epoch.advance())
                 self.state = TargetState.IN_PROGRESS
+                if hooks.enabled:
+                    hooks.epoch_milestone(
+                        "epoch.active", self.my_config.id, self.number
+                    )
                 for node in self.network_config.nodes:
                     self.prestart_buffers[node].iterate(
                         lambda *_: Applyable.CURRENT,  # drain everything

@@ -14,6 +14,7 @@ change, or continuing an in-flight epoch change.
 from __future__ import annotations
 
 from .. import pb
+from ..obsv import hooks
 from .actions import Actions
 from .batch_tracker import BatchTracker
 from .client_tracker import ClientTracker
@@ -219,6 +220,10 @@ class EpochTracker:
         # as placeholders, epoch_tracker.go:199-202,249).
         self.current_epoch.my_leader_choice = list(self.network_config.nodes)
         self.ticks_out_of_correct_epoch = 0
+        if hooks.enabled:
+            hooks.epoch_milestone(
+                "epoch.changing", self.my_config.id, new_number
+            )
 
         actions = self.persisted.add_ec_entry(
             pb.ECEntry(epoch_number=new_number)

@@ -24,6 +24,8 @@ W7 lint rule).
 
 from __future__ import annotations
 
+import time
+
 from .metrics import CardinalityError
 
 enabled = False
@@ -31,6 +33,11 @@ metrics = None  # Registry when enabled, else None
 tracer = None  # Tracer when tracing was requested, else None
 sim_now = None  # simulated ms (testengine runs), None under the runtime
 sample_rate = None  # span sampling rate in (0, 1], None = keep everything
+
+# (node, epoch) -> perf_counter at "epoch.changing"; consumed by
+# "epoch.active" to observe mirbft_epoch_change_seconds.  Cleared on
+# enable/disable so back-to-back runs do not cross-pollinate durations.
+_epoch_change_started: dict = {}
 
 
 def enable(registry=None, trace=False, sample_rate=None, sample_seed=0):
@@ -54,6 +61,7 @@ def enable(registry=None, trace=False, sample_rate=None, sample_seed=0):
     tracer = Tracer(sampler=sampler) if trace else None
     sim_now = None
     globals()["sample_rate"] = sample_rate
+    _epoch_change_started.clear()
     enabled = True
     return metrics, tracer
 
@@ -66,6 +74,7 @@ def disable():
     tracer = None
     sim_now = None
     sample_rate = None
+    _epoch_change_started.clear()
 
 
 def milestone(name, node, seq, epoch=None, bucket=None):
@@ -108,6 +117,45 @@ def milestone(name, node, seq, epoch=None, bucket=None):
                 m.counter("mirbft_seq_milestones_total", milestone=name).inc()
         except CardinalityError:
             pass  # over budget: keep the instant, drop the counter
+
+
+def epoch_milestone(name, node, epoch):
+    """Emit an epoch-change milestone: ``epoch.changing`` when a node
+    constructs and broadcasts its epoch-change message, ``epoch.active``
+    when the new epoch's ActiveEpoch takes over.
+
+    Each milestone is an instant + a flow step on the per-epoch flow
+    family ``"e.<epoch>"`` (so merge.py can stitch the change across node
+    lanes, like checkpoints' ``"c.<seq>"``) + a counter.  The changing ->
+    active pair additionally times the outage:
+    ``mirbft_epoch_change_seconds`` observes how long this node spent
+    between giving up on the old epoch and activating the new one — the
+    liveness gap chaos runs assert on.  Epoch 0 activates at boot with no
+    preceding "changing", so it never records a duration.
+    """
+    args = {"node": node, "epoch": epoch, "sim_ms": sim_now}
+    t = tracer
+    if t is not None:
+        t.instant(name, cat="consensus", tid=node, args=args)
+        t.flow_step(name, tid=node, flow_id=f"e.{epoch}")
+    m = metrics
+    if m is not None:
+        try:
+            m.counter(
+                "mirbft_epoch_events_total",
+                event=name.split(".", 1)[1],
+                epoch=str(epoch),
+            ).inc()
+        except CardinalityError:
+            pass  # over budget: keep the instant, drop the counter
+        if name == "epoch.changing":
+            _epoch_change_started[(node, epoch)] = time.perf_counter()
+        elif name == "epoch.active":
+            start = _epoch_change_started.pop((node, epoch), None)
+            if start is not None:
+                m.histogram("mirbft_epoch_change_seconds").observe(
+                    time.perf_counter() - start
+                )
 
 
 def record_flush(plane, path, items, seconds=None):

@@ -40,12 +40,15 @@ CATALOG = {
     "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
     "mirbft_chaos_dropped_total": "Messages dropped by chaos manglers, per scenario.",
     "mirbft_chaos_duplicated_total": "Messages duplicated by chaos manglers, per scenario.",
+    "mirbft_chaos_live_recovery_ms": "Live chaos scenario: wall ms from the last heal/restart to convergence.",
     "mirbft_chaos_recovery_ms": "Chaos scenario recovery time: completion minus last disruption end (simulated ms).",
     "mirbft_crypto_flush_seconds": "Blocking wall time of one crypto-plane flush/launch/readback.",
     "mirbft_crypto_flush_total": "Crypto-plane flush/launch/readback operations, by plane and path.",
     "mirbft_crypto_items_total": "Digests or signature verdicts produced, by plane and path (device/host/readback/rescued/inline/batch).",
     "mirbft_engine_events_total": "Events processed by a testengine Recorder run.",
     "mirbft_engine_sim_ms": "Final simulated clock of a testengine Recorder run.",
+    "mirbft_epoch_change_seconds": "Wall time from constructing an epoch change to activating the new epoch, per node observation.",
+    "mirbft_epoch_events_total": "Epoch-change milestones (changing/active), by event and epoch.",
     "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
     "mirbft_reqstore_appends_total": "Request-store record appends.",
     "mirbft_seq_milestones_total": "Consensus milestones reached, by milestone name, epoch, and bucket.",
@@ -54,8 +57,8 @@ CATALOG = {
     "mirbft_sm_actions_total": "Actions emitted by StateMachine.apply_event, by kind.",
     "mirbft_sm_apply_seconds": "Wall time per StateMachine.apply_event call.",
     "mirbft_sm_events_total": "State-machine events applied, by event type.",
-    "mirbft_transport_frames_total": "Transport frames, by outcome (enqueued/sent/dropped_overflow/dropped_closed/send_failure/dropped_unknown).",
-    "mirbft_transport_reconnects_total": "Transport dial attempts, by outcome (connected/failed).",
+    "mirbft_transport_frames_total": "Transport frames, by outcome (enqueued/sent/dropped_overflow/dropped_closed/send_failure/dropped_unknown/dropped_fault).",
+    "mirbft_transport_reconnects_total": "Transport dial attempts, by outcome (connected/failed/timeout/faulted).",
     "mirbft_wal_appends_total": "WAL record appends.",
     "mirbft_wal_fsync_seconds": "Wall time per WAL fsync.",
     "mirbft_wal_fsyncs_total": "WAL fsync calls.",
@@ -68,12 +71,15 @@ CATALOG_LABELS = {
     "mirbft_bench_stage_seconds": ("stage",),
     "mirbft_chaos_dropped_total": ("scenario",),
     "mirbft_chaos_duplicated_total": ("scenario",),
+    "mirbft_chaos_live_recovery_ms": ("scenario",),
     "mirbft_chaos_recovery_ms": ("scenario",),
     "mirbft_crypto_flush_seconds": ("plane",),
     "mirbft_crypto_flush_total": ("plane", "path"),
     "mirbft_crypto_items_total": ("plane", "path"),
     "mirbft_engine_events_total": ("stage",),
     "mirbft_engine_sim_ms": ("stage",),
+    "mirbft_epoch_change_seconds": (),
+    "mirbft_epoch_events_total": ("event", "epoch"),
     "mirbft_proc_phase_seconds": ("phase",),
     "mirbft_reqstore_appends_total": (),
     "mirbft_reqstore_fsync_seconds": (),

@@ -105,7 +105,12 @@ class Backoff:
     def next(self) -> float:
         """Delay (seconds) to sleep before the next attempt."""
         ceiling = min(self.cap, self.base * self.factor**self.attempt)
-        self.attempt += 1
+        # Stop growing the exponent once the ceiling has reached the cap:
+        # a permanently-dead peer retries forever, and an unbounded
+        # ``attempt`` eventually overflows ``factor**attempt`` (a float
+        # OverflowError around attempt ~1024 kills the sender thread).
+        if ceiling < self.cap:
+            self.attempt += 1
         return ceiling * (0.5 + 0.5 * self._rng.random())
 
     def reset(self) -> None:

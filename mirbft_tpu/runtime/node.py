@@ -97,6 +97,8 @@ class Node:
         self._inbox: queue.Queue = queue.Queue()
         self._outbox: queue.Queue = queue.Queue(maxsize=1)
         self._stopped = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._stop_done = False
         self._exit_error: BaseException | None = None
         self._machine = StateMachine(logger=config.logger)
         self._waiters: list[_Waiter] = []
@@ -206,10 +208,18 @@ class Node:
             return None
 
     def stop(self) -> None:
-        self._stopped.set()
-        self._put(("stop",))
-        self._thread.join(timeout=10)
-        self._close_exporter()
+        """Idempotent, concurrency-safe shutdown: the first caller tears
+        down (serializer joined, exporter closed); later and concurrent
+        callers wait for that teardown rather than racing it."""
+        with self._stop_lock:
+            if not self._stop_done:
+                self._stop_done = True
+                self._stopped.set()
+                # Bypass _put: it refuses new work once stopped, but the
+                # sentinel must always reach the serializer.
+                self._inbox.put(("stop",))
+            self._thread.join(timeout=10)
+            self._close_exporter()
 
     @property
     def exit_error(self):
@@ -279,8 +289,11 @@ class Node:
             )
 
             is_bootstrap = isinstance(self._wal_storage, _BootstrapWal)
+            loaded = 0
 
             def load_entry(index, entry):
+                nonlocal loaded
+                loaded += 1
                 if is_bootstrap:
                     # Re-persist the synthesized log into the real WAL.
                     actions.persist(index, entry)
@@ -292,6 +305,16 @@ class Node:
                 )
 
             self._wal_storage.load_all(load_entry)
+            if not is_bootstrap and loaded == 0:
+                # Restart-from-disk hardening: an empty WAL on restart
+                # means the log was lost or the wrong directory was
+                # mounted.  Silently proceeding would re-initialize at
+                # seq 0 and fork against the rest of the cluster; fail
+                # loudly instead (surfaced via exit_error).
+                raise RuntimeError(
+                    "restart with empty WAL: refusing to rejoin without "
+                    "a persisted checkpoint (use start_new to bootstrap)"
+                )
 
             def load_request(ack):
                 # Discard resulting actions: replayed request acks must not

@@ -67,6 +67,10 @@ class FileWal:
         self._active = None  # open file handle for appends
         self._active_size = 0
         self._needs_sync = False
+        # Fault-injection seam (chaos/live.py): called with no arguments
+        # immediately before every fsync; raising OSError from it models a
+        # failing disk.  None in production.
+        self.fault_hook = None
         # Coarse mutex, like the reference simplewal's (simplewal.go:22-109):
         # the pooled processor runs persist and commit lanes concurrently.
         self._lock = threading.Lock()
@@ -175,6 +179,8 @@ class FileWal:
     def sync(self) -> None:
         with self._lock:
             if self._active is not None and self._needs_sync:
+                if self.fault_hook is not None:
+                    self.fault_hook()
                 start = time.perf_counter() if hooks.enabled else 0.0
                 self._active.flush()
                 os.fsync(self._active.fileno())
@@ -188,6 +194,16 @@ class FileWal:
 
     def close(self) -> None:
         self.sync()
+        with self._lock:
+            if self._active is not None:
+                self._active.close()
+                self._active = None
+
+    def crash(self) -> None:
+        """Crash-kill teardown: release the file handle WITHOUT the
+        close-time fsync, modeling power loss.  Unsynced appends may or
+        may not survive — exactly the window the durable-prefix invariant
+        must tolerate."""
         with self._lock:
             if self._active is not None:
                 self._active.close()
@@ -215,6 +231,8 @@ class FileRequestStore:
         self._replay()
         self._compact()
         self._file = open(self._log_path, "ab")
+        # Pre-fsync fault seam, mirroring FileWal.fault_hook.
+        self.fault_hook = None
         # store/commit run from different pooled lanes (reference reqstore
         # wraps BadgerDB, which is internally synchronized; our file log
         # needs the mutex).
@@ -289,6 +307,8 @@ class FileRequestStore:
 
     def sync(self) -> None:
         with self._lock:
+            if self.fault_hook is not None:
+                self.fault_hook()
             start = time.perf_counter() if hooks.enabled else 0.0
             self._file.flush()
             os.fsync(self._file.fileno())
@@ -306,4 +326,11 @@ class FileRequestStore:
             for_each(self._index[key][0])
 
     def close(self) -> None:
+        self._file.close()
+
+    def crash(self) -> None:
+        """Crash-kill teardown: release the handle without the orderly
+        fsync (see FileWal.crash).  In-process simulation cannot drop the
+        page cache, but the skipped fsync still distinguishes the crash
+        path from clean shutdown for the durable-prefix audit."""
         self._file.close()

@@ -90,6 +90,26 @@ def _dial_outcome(outcome: str) -> None:
         ).inc()
 
 
+class TransportFault:
+    """Fault-injection seam consulted on the transport's hot paths.
+
+    Chaos drivers (chaos/live.py) subclass this and assign an instance to
+    ``TcpTransport.fault``; production leaves the attribute ``None`` so
+    the seam costs one attribute read per send/dial.  Both hooks run on
+    transport-internal threads and must not block.
+    """
+
+    def on_dial(self, peer_id: int) -> bool:
+        """Return False to fail this dial attempt (counted as a
+        ``faulted`` reconnect outcome; the normal backoff applies)."""
+        return True
+
+    def on_send(self, peer_id: int, frame: bytes) -> bool:
+        """Return False to drop this frame before it is enqueued
+        (counted as a ``dropped_fault`` frame outcome)."""
+        return True
+
+
 class _PeerChannel:
     """Outbound lane to one peer: a bounded frame queue plus the sender
     thread that owns connecting, retrying, and draining it."""
@@ -200,8 +220,30 @@ class _PeerChannel:
                 # No new connections once closing; draining only flushes
                 # over connections that already exist.
                 return None
+            fault = transport.fault
+            if fault is not None and not fault.on_dial(self.peer_id):
+                self.connect_failures += 1
+                _dial_outcome("faulted")
+                delay = self.backoff.next()
+                with self.cv:
+                    if not self.closed:
+                        self.cv.wait(timeout=delay)
+                continue
             try:
-                conn = socket.create_connection(address, timeout=5)
+                conn = socket.create_connection(
+                    address, timeout=transport.dial_timeout
+                )
+            except TimeoutError:
+                # Dial deadline: a peer that accepts SYNs but never
+                # completes (or a black-holing firewall) cannot pin the
+                # sender thread longer than dial_timeout per attempt.
+                self.connect_failures += 1
+                _dial_outcome("timeout")
+                delay = self.backoff.next()
+                with self.cv:
+                    if not self.closed:
+                        self.cv.wait(timeout=delay)
+                continue
             except OSError:
                 self.connect_failures += 1
                 _dial_outcome("failed")
@@ -256,11 +298,15 @@ class TcpTransport:
         queue_depth: int = 1024,
         backoff_base: float = 0.05,
         backoff_cap: float = 2.0,
+        dial_timeout: float = 5.0,
     ):
         self.node_id = node_id
         self.queue_depth = queue_depth
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        self.dial_timeout = dial_timeout
+        # Fault-injection seam (TransportFault); None in production.
+        self.fault: TransportFault | None = None
         self._node = None
         self._peers: dict[int, tuple] = {}  # id -> (host, port)
         # id -> (socket, per-connection send lock).  The transport-wide
@@ -270,6 +316,8 @@ class TcpTransport:
         self._channels: dict[int, _PeerChannel] = {}
         # Sends to peers never registered via connect(): dropped, counted.
         self.dropped_unknown = 0
+        # Frames suppressed by the fault seam (chaos runs only).
+        self.dropped_fault = 0
         # peer id -> (local perf_counter_ns - peer perf_counter_ns),
         # estimated from the clock-sync hello on each inbound connection.
         self._clock_offsets: dict[int, int] = {}
@@ -278,6 +326,10 @@ class TcpTransport:
         # the port occupied past a rebind, and — worse — lets a "closed"
         # transport keep delivering frames to its sink.
         self._accepted: set[socket.socket] = set()
+        # Reader threads for accepted sockets, tracked so close() can join
+        # them: a daemon thread parked in recv survives close() otherwise,
+        # and 100 start/stop cycles then leak 100 threads.
+        self._read_threads: set[threading.Thread] = set()
         self._lock = threading.Lock()
         self._closed = threading.Event()
 
@@ -327,6 +379,11 @@ class TcpTransport:
     def _send(self, dest: int, msg: pb.Msg) -> None:
         payload = wire.encode_varint(self.node_id) + pb.encode(msg)
         frame = _LEN.pack(len(payload)) + payload
+        fault = self.fault
+        if fault is not None and not fault.on_send(dest, frame):
+            self.dropped_fault += 1
+            _frame_outcome("dropped_fault")
+            return  # injected loss: indistinguishable from the network's
         channel = self._channel(dest)
         if channel is None:
             self.dropped_unknown += 1
@@ -354,7 +411,11 @@ class TcpTransport:
                     "connect_failures": ch.connect_failures,
                     "connects": ch.connects,
                 }
-        return {"dropped_unknown": self.dropped_unknown, "peers": peers}
+        return {
+            "dropped_unknown": self.dropped_unknown,
+            "dropped_fault": self.dropped_fault,
+            "peers": peers,
+        }
 
     # -- inbound ---------------------------------------------------------------
 
@@ -364,17 +425,19 @@ class TcpTransport:
                 conn, _addr = self._server.accept()
             except OSError:
                 return  # closed
+            thread = threading.Thread(
+                target=self._read_loop,
+                args=(conn,),
+                name=f"tcp-read-{self.node_id}",
+                daemon=True,
+            )
             with self._lock:
                 if self._closed.is_set():
                     conn.close()
                     return
                 self._accepted.add(conn)
-            threading.Thread(
-                target=self._read_loop,
-                args=(conn,),
-                name=f"tcp-read-{self.node_id}",
-                daemon=True,
-            ).start()
+                self._read_threads.add(thread)
+            thread.start()
 
     def _read_loop(self, conn: socket.socket) -> None:
         try:
@@ -392,6 +455,7 @@ class TcpTransport:
         finally:
             with self._lock:
                 self._accepted.discard(conn)
+                self._read_threads.discard(threading.current_thread())
             conn.close()
 
     @staticmethod
@@ -485,3 +549,12 @@ class TcpTransport:
             except OSError:
                 pass
             conn.close()
+        # Join the accept/read threads so close() returning means no
+        # transport thread is still running (no leaks across restarts).
+        self._accept_thread.join(timeout=5)
+        with self._lock:
+            readers = list(self._read_threads)
+        current = threading.current_thread()
+        for thread in readers:
+            if thread is not current:
+                thread.join(timeout=5)

@@ -183,6 +183,36 @@ def test_restart_replay_does_not_reapply_committed_batches():
 
 
 # ---------------------------------------------------------------------------
+# Epoch-change-targeted and signed-mode scenarios (deterministic engine)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_leader_isolation_forces_epoch_change_exactly_once():
+    """Leader 0 held isolated far past the suspect timeout under 5% loss:
+    the survivors must change epochs, re-propose the suspect's in-flight
+    sequences, and commit every request exactly once (check_no_fork
+    inside the runner rejects duplicates and forks; ``passed`` carries
+    that proof).  Seeded, so the exact message-loss pattern replays."""
+    result = run_scenario(BY_NAME["leader-isolation-epoch-change"], seed=7)
+    assert result.passed, result.violation
+    assert result.counters["epoch"] >= 1
+    assert result.commits > 0
+
+
+@pytest.mark.chaos
+def test_signed_mode_verifier_death_walks_breaker_to_recovery():
+    """Signed mode: the signature device dies mid-run; the breaker trips,
+    verification falls back to the host oracle, and a later probe
+    re-closes the circuit — all without stalling commits."""
+    result = run_scenario(BY_NAME["signed-verifier-dies"], seed=3)
+    assert result.passed, result.violation
+    assert result.counters["sig_device_errors"] >= 1
+    assert result.counters["sig_fallbacks"] >= 1
+    assert result.counters["sig_breaker"] == "closed"
+
+
+# ---------------------------------------------------------------------------
 # The full matrix (slow lane; also: python -m mirbft_tpu.chaos)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,186 @@
+"""Live-cluster chaos tier (chaos/live.py): the fault campaign against
+the real TCP runtime — real Nodes, real sockets, real fsyncs.
+
+Three layers:
+
+- A tier-1 smoke pass over LIVE_SMOKE_NAMES (one crash+restart, one
+  partition+heal) under a hard wall-clock budget, so every CI run
+  exercises a real cluster surviving a real fault.
+- A tier-1 teardown-leak gate: 100 boot/teardown cycles of Node +
+  TcpTransport on fixed ports.  Node.stop() joins the serializer and
+  TcpTransport.close() joins accept/read/sender threads; this test is
+  the regression net — before those joins existed, each cycle leaked a
+  daemon thread parked in recv and the 100th cycle ran alongside 100
+  zombies.
+- The full live matrix (epoch-change-targeted leader isolation, signed
+  mode, failing fsyncs) behind ``-m chaos`` with the long tail behind
+  ``slow``, mirroring tests/test_chaos.py's deterministic campaign.
+"""
+
+import threading
+import time
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.chaos import (
+    LIVE_SMOKE_NAMES,
+    live_matrix,
+    run_live_campaign,
+    run_live_scenario,
+)
+from mirbft_tpu.runtime import Config, Node, TcpTransport
+from mirbft_tpu.runtime.node import standard_initial_network_state
+
+BY_NAME = {s.name: s for s in live_matrix()}
+
+# Every thread the runtime plane spawns carries one of these name
+# prefixes (node.py / transport.py / live.py); the leak gate counts them.
+RUNTIME_THREAD_PREFIXES = ("mirbft-serializer-", "tcp-", "live-consumer-")
+
+
+def _runtime_threads() -> list:
+    return [
+        t
+        for t in threading.enumerate()
+        if t.name.startswith(RUNTIME_THREAD_PREFIXES)
+    ]
+
+
+def _bind_retrying(node_id: int, port: int) -> TcpTransport:
+    """Bind a transport, retrying through TIME_WAIT on a fixed port (the
+    same discipline live.py's LiveReplica._bind uses for restarts)."""
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            return TcpTransport(node_id, port=port, dial_timeout=1.0)
+        except OSError:
+            if port == 0 or time.monotonic() >= deadline:
+                raise
+            time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: live smoke under a wall-clock budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("name", LIVE_SMOKE_NAMES)
+def test_live_smoke_scenario_survives_real_fault(name):
+    """A real loopback cluster absorbs the fault, recovers within the
+    scenario's bound, and the whole run fits a hard wall-clock budget —
+    the tier-1 proof that the campaign works against real sockets, not
+    just the simulator."""
+    start = time.monotonic()
+    result = run_live_scenario(BY_NAME[name], seed=0, budget_s=60.0)
+    elapsed = time.monotonic() - start
+    assert result.passed, f"{name}: {result.violation}"
+    assert result.commits > 0
+    # Real TCP connections were dialed — this ran on sockets.
+    assert result.counters["tcp_connects"] > 0
+    assert elapsed < 75.0, f"{name} blew the wall-clock budget: {elapsed:.1f}s"
+
+
+@pytest.mark.chaos
+def test_live_smoke_leaves_no_runtime_threads():
+    """After a live scenario tears down, no serializer/transport/consumer
+    threads may linger — the smoke pass doubles as a teardown audit."""
+    run_live_scenario(BY_NAME["partition-minority"], seed=1, budget_s=60.0)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and _runtime_threads():
+        time.sleep(0.05)
+    leaked = _runtime_threads()
+    assert not leaked, f"leaked threads: {[t.name for t in leaked]}"
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: 100 start/stop cycles leak nothing and rebind their ports
+# ---------------------------------------------------------------------------
+
+
+def test_hundred_node_transport_cycles_leak_free():
+    """100 boot/teardown cycles of a real two-node cluster: every cycle
+    re-binds the SAME ports (teardown must release them all the way to
+    the kernel) and the thread census at the end matches the start
+    (Node.stop() joins the serializer; TcpTransport.close() joins the
+    accept, read, and sender threads — a daemon thread parked in recv
+    would otherwise survive and accumulate, 1 zombie per cycle)."""
+    baseline = len(_runtime_threads())
+    state = standard_initial_network_state(2, [1])
+    port_a = port_b = 0
+    for cycle in range(100):
+        ta = _bind_retrying(0, port_a)
+        tb = _bind_retrying(1, port_b)
+        port_a, port_b = ta.address[1], tb.address[1]
+        node_a = Node.start_new(Config(id=0), state)
+        node_b = Node.start_new(Config(id=1), state)
+        ta.serve(node_a)
+        tb.serve(node_b)
+        ta.connect(1, tb.address)
+        tb.connect(0, ta.address)
+        # One real frame each way: forces a dial, an accept, and a read
+        # thread on both sides, so teardown has the full thread set to
+        # reap.
+        ta.link().send(1, pb.Msg(type=pb.Suspect(epoch=0)))
+        tb.link().send(0, pb.Msg(type=pb.Suspect(epoch=0)))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            sent_a = ta.counters()["peers"].get(1, {}).get("sent", 0)
+            sent_b = tb.counters()["peers"].get(0, {}).get("sent", 0)
+            if sent_a >= 1 and sent_b >= 1:
+                break
+            time.sleep(0.005)
+        node_a.stop()
+        node_b.stop()
+        ta.close()
+        tb.close()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(_runtime_threads()) > baseline:
+        time.sleep(0.05)
+    residue = _runtime_threads()
+    assert len(residue) <= baseline, (
+        f"thread leak after 100 cycles: {[t.name for t in residue]}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier: epoch-change-targeted and signed-mode live scenarios
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_live_leader_isolation_forces_real_epoch_change():
+    """Isolating leader 0 at the socket level past the suspect timeout
+    must drive the surviving trio through a real epoch change — proven
+    by the obsv epoch.active milestone, not just by liveness."""
+    result = run_live_scenario(
+        BY_NAME["leader-isolation-epoch-change"], seed=1, budget_s=60.0
+    )
+    assert result.passed, result.violation
+    assert result.counters["epoch"] >= 1
+    assert result.counters["epoch_active_events"] >= 1
+    assert result.commits > 0
+
+
+@pytest.mark.chaos
+def test_live_signed_mode_verifier_death_recovers():
+    """Signed mode over real sockets: the verifier device dies mid-run,
+    the breaker trips to the host oracle, commits continue, and the
+    forged-request probe is still rejected (asserted inside the run)."""
+    result = run_live_scenario(
+        BY_NAME["signed-verifier-dies"], seed=2, budget_s=60.0
+    )
+    assert result.passed, result.violation
+    assert result.counters["sig_device_errors"] >= 1
+    assert result.counters["sig_fallbacks"] >= 1
+    assert result.commits > 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_live_full_campaign():
+    """The whole live matrix — crash, partition, loss, leader isolation,
+    signed mode, failing fsyncs — against real clusters."""
+    campaign = run_live_campaign(seed=0)
+    assert campaign.passed, campaign.report()

@@ -161,3 +161,39 @@ def test_linter_bans_http_server_outside_obsv(tmp_path):
             REPO / "mirbft_tpu" / "obsv" / "exporter.py"
         )
     )
+
+
+def test_linter_bans_raw_sockets_outside_transport_and_live(tmp_path):
+    """W9: all wire I/O goes through runtime/transport.py or the live
+    chaos driver's partition proxies; a raw socket anywhere else in
+    mirbft_tpu bypasses framing, reconnect, counters, and fault seams."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "core" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("import socket\nx = socket\n")
+    findings = lint.check_file(outside)
+    assert any("W9" in line for line in findings), findings
+
+    fromstyle = tmp_path / "mirbft_tpu" / "runtime" / "sneaky2.py"
+    fromstyle.parent.mkdir(parents=True)
+    fromstyle.write_text("from socket import create_server\nx = create_server\n")
+    assert any("W9" in line for line in lint.check_file(fromstyle))
+
+    # The two sanctioned socket users, checked against the real files.
+    assert not any(
+        "W9" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "runtime" / "transport.py"
+        )
+    )
+    assert not any(
+        "W9" in line
+        for line in lint.check_file(REPO / "mirbft_tpu" / "chaos" / "live.py")
+    )
+
+    # ``socketserver`` or tests are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text("import socket\nx = socket\n")
+    assert not any("W9" in line for line in lint.check_file(tests_ok))

@@ -401,8 +401,10 @@ def test_flow_events_well_formed_per_node(four_node_traces):
             by_id.setdefault(record["id"], []).append(record)
         triples = set()
         for flow_id, records in by_id.items():
-            if flow_id.startswith("c."):
-                continue  # checkpoint step flows are promoted at merge
+            if flow_id.startswith(("c.", "e.")):
+                # Checkpoint ("c.<seq>") and epoch-change ("e.<epoch>")
+                # step flows are promoted at merge, not per-seq triples.
+                continue
             epoch, seq, bucket = (int(x) for x in flow_id.split("."))
             assert (epoch, seq, bucket) not in triples
             triples.add((epoch, seq, bucket))
@@ -429,7 +431,7 @@ def test_merged_trace_connects_three_plus_lanes(four_node_traces):
     spanning = [
         flow_id
         for flow_id, records in by_id.items()
-        if not flow_id.startswith("c.")
+        if not flow_id.startswith(("c.", "e."))
         and len({r["pid"] for r in records}) >= 3
     ]
     assert spanning, "no committed seq flow connects >= 3 node lanes"

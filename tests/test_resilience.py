@@ -69,6 +69,22 @@ def test_backoff_grows_to_cap_with_jitter():
     assert b.next() <= 0.1
 
 
+def test_backoff_stays_bounded_over_thousands_of_failures():
+    """A peer down for hours produces thousands of consecutive dial
+    failures; the exponent must not overflow and every delay must stay
+    within [0, cap] — the transport's sender threads call next() in an
+    unbounded retry loop."""
+    b = Backoff(base=0.05, factor=2.0, cap=2.0, rng=random.Random(3))
+    delays = [b.next() for _ in range(5000)]
+    assert all(0.0 <= d <= 2.0 for d in delays)
+    # Deep into the failure run the delays still hover near the cap
+    # (full jitter: uniform in [cap/2, cap]), not collapsed or inf.
+    tail = delays[-100:]
+    assert all(1.0 <= d <= 2.0 for d in tail)
+    b.reset()
+    assert b.next() <= 0.05
+
+
 # ---------------------------------------------------------------------------
 # Digest plane: device failure degrades to the host oracle
 # ---------------------------------------------------------------------------

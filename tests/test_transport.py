@@ -555,6 +555,95 @@ def test_consensus_survives_transport_kill_and_restore():
     assert all(r.node.exit_error is None for r in replicas)
 
 
+def test_dial_timeout_bounds_blackholed_connects(monkeypatch):
+    """A peer that black-holes SYNs (firewall, dead VM) must not pin the
+    sender thread: every dial attempt carries the transport's
+    ``dial_timeout`` and a TimeoutError walks the normal backoff."""
+    from mirbft_tpu.runtime import transport as transport_module
+
+    seen_timeouts = []
+
+    def _blackhole(address, timeout=None, **_kw):
+        seen_timeouts.append(timeout)
+        raise TimeoutError("SYN black-holed")
+
+    sender = TcpTransport(0, dial_timeout=0.123, backoff_base=0.01,
+                          backoff_cap=0.05)
+    try:
+        monkeypatch.setattr(
+            transport_module.socket, "create_connection", _blackhole
+        )
+        sender.connect(1, ("127.0.0.1", 1))
+        sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=0)))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            c = sender.counters()["peers"].get(1, {})
+            if c.get("connect_failures", 0) >= 2:
+                break
+            time.sleep(0.01)
+        assert sender.counters()["peers"][1]["connect_failures"] >= 2
+        assert seen_timeouts and all(t == 0.123 for t in seen_timeouts)
+    finally:
+        monkeypatch.undo()
+        sender.close()
+
+
+def test_transport_fault_seam_injects_send_and_dial_loss():
+    """The TransportFault seam is the chaos driver's hook: on_send=False
+    frames vanish with ``dropped_fault`` accounting, on_dial=False fails
+    dials into the ordinary backoff path — and clearing the fault
+    restores delivery with no other intervention."""
+    from mirbft_tpu.runtime.transport import TransportFault
+
+    received = []
+
+    class _Sink:
+        def step(self, source, msg):
+            received.append(msg.type.epoch)
+
+    class _DropSends(TransportFault):
+        def on_send(self, peer_id, frame):
+            return False
+
+    class _FailDials(TransportFault):
+        def on_dial(self, peer_id):
+            return False
+
+    sender = TcpTransport(0, backoff_base=0.01, backoff_cap=0.05)
+    receiver = TcpTransport(1)
+    try:
+        sender.connect(1, receiver.address)
+        receiver.serve(_Sink())
+
+        sender.fault = _DropSends()
+        for epoch in range(3):
+            sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=epoch)))
+        assert sender.counters()["dropped_fault"] == 3
+        time.sleep(0.1)
+        assert received == []
+
+        # Dial faults: the frame enqueues but no connection can form.
+        sender.fault = _FailDials()
+        sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=7)))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if sender.counters()["peers"][1]["connect_failures"] >= 2:
+                break
+            time.sleep(0.01)
+        assert sender.counters()["peers"][1]["connect_failures"] >= 2
+        assert received == []
+
+        # Fault cleared: the queued frame flushes via the normal re-dial.
+        sender.fault = None
+        deadline = time.monotonic() + 5
+        while received != [7] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert received == [7]
+    finally:
+        sender.close()
+        receiver.close()
+
+
 def test_clock_sync_hello_records_offset():
     """The first frame on a fresh dial is the clock-sync hello: the
     receiver learns the dialer's monotonic anchor and exposes the

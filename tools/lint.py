@@ -23,6 +23,12 @@ enforces the defect classes that have actually bitten BFT codebases:
   renderer; ad-hoc handlers writing registry internals onto sockets
   bypass the catalog/cardinality contract.  Scoped to ``mirbft_tpu/``
   (tests and tools may use HTTP clients/servers freely).
+- W9 raw ``socket`` outside ``mirbft_tpu/runtime/transport.py`` and
+  ``mirbft_tpu/chaos/live.py`` — all wire I/O flows through the
+  transport (framing, reconnect/backoff, counters, fault seam) or the
+  live chaos driver's partition proxies; a stray socket elsewhere
+  bypasses every one of those disciplines.  Scoped to ``mirbft_tpu/``
+  (tests and tools may open sockets freely).
 
 Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
 Also enforced in CI-equivalent form by ``tests/test_lint.py``.
@@ -109,6 +115,23 @@ def _in_exposition_scope(path: Path) -> bool:
     http.server."""
     posix = path.resolve().as_posix()
     return "mirbft_tpu/" in posix and "mirbft_tpu/obsv/" not in posix
+
+
+# The only two files allowed to touch raw sockets: the transport owns
+# framing/reconnect/counters, and the live chaos driver's partition
+# proxies sit deliberately *under* the transport at the socket layer.
+SOCKET_ALLOWED_FILES = (
+    "mirbft_tpu/runtime/transport.py",
+    "mirbft_tpu/chaos/live.py",
+)
+
+
+def _in_socket_ban_scope(path: Path) -> bool:
+    """True for mirbft_tpu files where W9 bans raw ``socket`` imports."""
+    posix = path.resolve().as_posix()
+    return "mirbft_tpu/" in posix and not any(
+        posix.endswith(allowed) for allowed in SOCKET_ALLOWED_FILES
+    )
 
 
 def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
@@ -214,6 +237,25 @@ def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
                     f"{path}:{node.lineno}: W8 http.server outside obsv/ "
                     "(exposition must go through obsv.exporter and the "
                     "catalog renderer)"
+                )
+        if _in_socket_ban_scope(path):
+            hit = False
+            if isinstance(node, ast.Import):
+                hit = any(
+                    alias.name == "socket" or alias.name.startswith("socket.")
+                    for alias in node.names
+                )
+            elif isinstance(node, ast.ImportFrom):
+                hit = node.module is not None and (
+                    node.module == "socket"
+                    or node.module.startswith("socket.")
+                )
+            if hit:
+                findings.append(
+                    f"{path}:{node.lineno}: W9 raw socket outside "
+                    "runtime/transport.py and chaos/live.py (wire I/O "
+                    "goes through the transport or the live driver's "
+                    "partition proxies)"
                 )
 
     return findings
