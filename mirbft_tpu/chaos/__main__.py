@@ -59,6 +59,14 @@ def main(argv=None) -> int:
         "default 90)",
     )
     parser.add_argument(
+        "--processor",
+        default="serial",
+        choices=("serial", "pool", "tpu", "tpu-pool", "pipelined", "tpu-pipelined"),
+        help="action executor every live replica runs (--live only, "
+        "default serial); the full fault matrix must pass under any of "
+        "them",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     args = parser.parse_args(argv)
@@ -85,7 +93,10 @@ def main(argv=None) -> int:
     for seed in range(args.seed, args.seed + args.seeds):
         if args.live:
             campaign = run_live_campaign(
-                scenarios, seed=seed, budget_s=args.budget
+                scenarios,
+                seed=seed,
+                budget_s=args.budget,
+                processor=args.processor,
             )
         else:
             campaign = run_campaign(scenarios, seed=seed)

@@ -55,7 +55,7 @@ from ..runtime import (
     FileRequestStore,
     FileWal,
     Node,
-    SerialProcessor,
+    build_processor,
 )
 from ..runtime.node import NodeStopped, standard_initial_network_state
 from ..runtime.processor import Log
@@ -359,7 +359,11 @@ class LiveReplica:
         )
         self.wal = FileWal(os.path.join(self.dir, "wal"))
         self.reqstore = FileRequestStore(os.path.join(self.dir, "reqs"))
-        config = Config(id=node_id, batch_size=cluster.scenario.batch_size)
+        config = Config(
+            id=node_id,
+            batch_size=cluster.scenario.batch_size,
+            processor=cluster.processor,
+        )
         if initial_state is not None:
             self.node = Node.start_new(config, initial_state)
         else:
@@ -369,7 +373,7 @@ class LiveReplica:
         if cluster.drop_fault is not None:
             self.transport.fault = cluster.drop_fault
         self.transport.serve(self.node)
-        self.processor = SerialProcessor(
+        self.processor = build_processor(
             self.node,
             self.transport.link(),
             self.app_log,
@@ -379,6 +383,10 @@ class LiveReplica:
         # seq_no -> (value, pb.NetworkState): serves peers' state
         # transfers out of band (the consumer's job in the reference).
         self.checkpoints: dict = {}
+        # Pipelined executors hand results to the node internally; the
+        # checkpoint capture below must route through their seam.
+        if hasattr(self.processor, "on_results"):
+            self.processor.on_results = self._capture_checkpoints
         self.failed = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -422,6 +430,17 @@ class LiveReplica:
         self.wal.fault_hook = fail
         self.reqstore.fault_hook = fail
 
+    def _capture_checkpoints(self, results) -> None:
+        for cr in results.checkpoints:
+            self.checkpoints[cr.checkpoint.seq_no] = (
+                cr.value,
+                pb.NetworkState(
+                    config=cr.checkpoint.network_config,
+                    clients=cr.checkpoint.clients_state,
+                    pending_reconfigurations=list(cr.reconfigurations),
+                ),
+            )
+
     def _consume(self) -> None:
         tick_seconds = self.cluster.tick_seconds
         last_tick = time.monotonic()
@@ -430,17 +449,7 @@ class LiveReplica:
                 actions = self.node.ready(timeout=0.01)
                 if actions is not None:
                     results = self.processor.process(actions)
-                    for cr in results.checkpoints:
-                        self.checkpoints[cr.checkpoint.seq_no] = (
-                            cr.value,
-                            pb.NetworkState(
-                                config=cr.checkpoint.network_config,
-                                clients=cr.checkpoint.clients_state,
-                                pending_reconfigurations=list(
-                                    cr.reconfigurations
-                                ),
-                            ),
-                        )
+                    self._capture_checkpoints(results)
                     if results.digests or results.checkpoints:
                         self.node.add_results(results)
                 now = time.monotonic()
@@ -478,8 +487,22 @@ class LiveReplica:
         without their shutdown fsync, so only what the runtime already
         synced is durable."""
         self._stop.set()
+        closer = getattr(self.processor, "close", None)
+        if closer is not None and not graceful:
+            # Crash-kill: park the pipeline *before* joining the consumer
+            # — a consumer blocked in a backpressure put must be released,
+            # and in-flight batches are abandoned like any other un-synced
+            # work under kill -9.
+            try:
+                closer(wait=False)
+            except TypeError:
+                closer()  # PoolProcessor.close takes no args
         if self._thread.ident is not None:
             self._thread.join(timeout=10)
+        if closer is not None and graceful:
+            # Clean shutdown: drain in-flight batches (commits land, the
+            # WAL/reqstore group syncers flush) before storage closes.
+            closer()
         self.transport.close(0)
         self.node.stop()
         if graceful:
@@ -504,11 +527,15 @@ class LiveCluster:
         tick_seconds: float,
         budget_s: float,
         max_reqs_per_client: int,
+        processor: str = "serial",
     ):
         self.scenario = scenario
         self.seed = seed
         self.tick_seconds = tick_seconds
         self.budget_s = budget_s
+        # Executor kind every replica builds (Config.processor): the same
+        # fault matrix must hold under serial, pooled, and pipelined.
+        self.processor = processor
         # Live runs pay real fsyncs per commit; the deterministic matrix's
         # larger request counts (sized for client-window coverage) are
         # clamped so each scenario stays inside its wall-clock budget.
@@ -870,6 +897,7 @@ def run_live_scenario(
     tick_seconds: float = 0.04,
     budget_s: float = 90.0,
     max_reqs_per_client: int = 40,
+    processor: str = "serial",
 ) -> ScenarioResult:
     """Execute one scenario against a real loopback cluster and audit
     every invariant.  Invariant violations are reported in the result,
@@ -889,7 +917,12 @@ def run_live_scenario(
     result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
     epoch_active_before = _epoch_active_total(registry)
     cluster = LiveCluster(
-        scenario, seed, tick_seconds, budget_s, max_reqs_per_client
+        scenario,
+        seed,
+        tick_seconds,
+        budget_s,
+        max_reqs_per_client,
+        processor=processor,
     )
     try:
         try:
@@ -984,6 +1017,7 @@ def run_live_campaign(
     seed: int = 0,
     tick_seconds: float = 0.04,
     budget_s: float = 90.0,
+    processor: str = "serial",
 ) -> CampaignResult:
     """Run a scenario list (default: the live matrix) against real
     clusters, one at a time, under derived per-scenario seeds."""
@@ -997,6 +1031,7 @@ def run_live_campaign(
                 seed=seed + index,
                 tick_seconds=tick_seconds,
                 budget_s=budget_s,
+                processor=processor,
             )
         )
     return campaign

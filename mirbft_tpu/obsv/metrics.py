@@ -50,18 +50,24 @@ CATALOG = {
     "mirbft_epoch_change_seconds": "Wall time from constructing an epoch change to activating the new epoch, per node observation.",
     "mirbft_epoch_events_total": "Epoch-change milestones (changing/active), by event and epoch.",
     "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
+    "mirbft_proc_stage_queue_depth": "Pipelined processor: batches queued at each stage hand-off.",
     "mirbft_reqstore_appends_total": "Request-store record appends.",
+    "mirbft_reqstore_group_commit_batches": "Request-store sync tickets satisfied by group-commit fsyncs.",
+    "mirbft_reqstore_group_sync_wait_seconds": "Per-waiter request-store group-commit latency (ticket issue to durable).",
     "mirbft_seq_milestones_total": "Consensus milestones reached, by milestone name, epoch, and bucket.",
     "mirbft_reqstore_fsync_seconds": "Wall time per request-store fsync.",
     "mirbft_reqstore_fsyncs_total": "Request-store fsync calls.",
     "mirbft_sm_actions_total": "Actions emitted by StateMachine.apply_event, by kind.",
     "mirbft_sm_apply_seconds": "Wall time per StateMachine.apply_event call.",
     "mirbft_sm_events_total": "State-machine events applied, by event type.",
+    "mirbft_transport_frames_per_write": "Frames coalesced into each transport sendall.",
     "mirbft_transport_frames_total": "Transport frames, by outcome (enqueued/sent/dropped_overflow/dropped_closed/send_failure/dropped_unknown/dropped_fault).",
     "mirbft_transport_reconnects_total": "Transport dial attempts, by outcome (connected/failed/timeout/faulted).",
     "mirbft_wal_appends_total": "WAL record appends.",
     "mirbft_wal_fsync_seconds": "Wall time per WAL fsync.",
     "mirbft_wal_fsyncs_total": "WAL fsync calls.",
+    "mirbft_wal_group_commit_batches": "WAL sync tickets satisfied by group-commit fsyncs.",
+    "mirbft_wal_group_sync_wait_seconds": "Per-waiter WAL group-commit latency (ticket issue to durable).",
 }
 
 # name -> allowed label names.  A strict registry rejects any label key
@@ -81,18 +87,24 @@ CATALOG_LABELS = {
     "mirbft_epoch_change_seconds": (),
     "mirbft_epoch_events_total": ("event", "epoch"),
     "mirbft_proc_phase_seconds": ("phase",),
+    "mirbft_proc_stage_queue_depth": ("stage",),
     "mirbft_reqstore_appends_total": (),
+    "mirbft_reqstore_group_commit_batches": (),
+    "mirbft_reqstore_group_sync_wait_seconds": (),
     "mirbft_reqstore_fsync_seconds": (),
     "mirbft_reqstore_fsyncs_total": (),
     "mirbft_seq_milestones_total": ("milestone", "epoch", "bucket"),
     "mirbft_sm_actions_total": ("kind",),
     "mirbft_sm_apply_seconds": (),
     "mirbft_sm_events_total": ("type",),
+    "mirbft_transport_frames_per_write": (),
     "mirbft_transport_frames_total": ("outcome",),
     "mirbft_transport_reconnects_total": ("outcome",),
     "mirbft_wal_appends_total": (),
     "mirbft_wal_fsync_seconds": (),
     "mirbft_wal_fsyncs_total": (),
+    "mirbft_wal_group_commit_batches": (),
+    "mirbft_wal_group_sync_wait_seconds": (),
 }
 
 # Per-family series budgets.  Most label spaces here are small and

@@ -13,10 +13,14 @@ from .config import Config  # noqa: F401
 from .log import ConsoleLogger, LogLevel  # noqa: F401
 from .node import ClientProposer, Node  # noqa: F401
 from .processor import (  # noqa: F401
+    PipelinedProcessor,
     PoolProcessor,
+    ProcessorClosed,
     SerialProcessor,
+    TpuPipelinedProcessor,
     TpuPoolProcessor,
     TpuProcessor,
+    build_processor,
 )
 from .storage import FileRequestStore, FileWal  # noqa: F401
 from .transport import TcpTransport  # noqa: F401

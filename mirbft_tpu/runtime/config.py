@@ -36,9 +36,18 @@ class Config:
     # from the obsv registry/status module — see obsv/exporter.py.
     metrics_port: int | None = None
     metrics_host: str = "127.0.0.1"
+    # Action-executor selection for runtime embedders that build their
+    # processor via runtime.build_processor (chaos/live.py, bench.py):
+    # serial | pool | tpu | tpu-pool | pipelined | tpu-pipelined.
+    processor: str = "serial"
 
     def __post_init__(self):
         if self.logger is None:
             self.logger = ConsoleLogger()
         if self.new_epoch_timeout_ticks < 2:
             raise ValueError("new_epoch_timeout_ticks must be >= 2")
+        valid = ("serial", "pool", "tpu", "tpu-pool", "pipelined", "tpu-pipelined")
+        if self.processor not in valid:
+            raise ValueError(
+                f"processor must be one of {valid}, got {self.processor!r}"
+            )

@@ -35,8 +35,15 @@ from mirbft_tpu.runtime.node import standard_initial_network_state
 BY_NAME = {s.name: s for s in live_matrix()}
 
 # Every thread the runtime plane spawns carries one of these name
-# prefixes (node.py / transport.py / live.py); the leak gate counts them.
-RUNTIME_THREAD_PREFIXES = ("mirbft-serializer-", "tcp-", "live-consumer-")
+# prefixes (node.py / transport.py / live.py / processor.py /
+# storage.py); the leak gate counts them.
+RUNTIME_THREAD_PREFIXES = (
+    "mirbft-serializer-",
+    "tcp-",
+    "live-consumer-",
+    "proc-pipe-",
+    "storage-sync-",
+)
 
 
 def _runtime_threads() -> list:

@@ -197,3 +197,94 @@ def test_linter_bans_raw_sockets_outside_transport_and_live(tmp_path):
     tests_ok.parent.mkdir(parents=True)
     tests_ok.write_text("import socket\nx = socket\n")
     assert not any("W9" in line for line in lint.check_file(tests_ok))
+
+
+def test_linter_confines_fsync_to_storage(tmp_path):
+    """W10a: os.fsync belongs to the stores' group-commit machinery (and
+    the live chaos driver's durable app log); a stray fsync anywhere
+    else silently reintroduces the per-batch sync cost the pipelined
+    commit path amortizes away."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "runtime" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("import os\n\ndef f(fd):\n    os.fsync(fd)\n")
+    findings = lint.check_file(outside)
+    assert any("W10" in line for line in findings), findings
+
+    fromstyle = tmp_path / "mirbft_tpu" / "core" / "sneaky2.py"
+    fromstyle.parent.mkdir(parents=True)
+    fromstyle.write_text("from os import fsync\nx = fsync\n")
+    assert any("W10" in line for line in lint.check_file(fromstyle))
+
+    # The sanctioned fsync users, checked against the real files.
+    assert not any(
+        "W10" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "runtime" / "storage.py"
+        )
+    )
+    assert not any(
+        "W10" in line
+        for line in lint.check_file(REPO / "mirbft_tpu" / "chaos" / "live.py")
+    )
+
+    # Tests and tools are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text("import os\n\ndef f(fd):\n    os.fsync(fd)\n")
+    assert not any("W10" in line for line in lint.check_file(tests_ok))
+
+
+def test_linter_bans_raw_threads_in_processor_outside_spawn_stage(tmp_path):
+    """W10b: runtime/processor.py creates stage threads only through
+    _spawn_stage, so naming, daemonization, and the leak gate stay
+    uniform."""
+    import lint
+
+    rogue = tmp_path / "mirbft_tpu" / "runtime" / "processor.py"
+    rogue.parent.mkdir(parents=True)
+    rogue.write_text(
+        "import threading\n"
+        "\n"
+        "def _spawn_stage(name, fn):\n"
+        "    return threading.Thread(target=fn, name=name, daemon=True)\n"
+        "\n"
+        "def rogue(fn):\n"
+        "    return threading.Thread(target=fn)\n"
+    )
+    findings = lint.check_file(rogue)
+    assert any("W10" in line and ":7:" in line for line in findings), findings
+    # The helper itself is the sanctioned creation point.
+    assert not any(":4:" in line for line in findings), findings
+
+    fromstyle = tmp_path / "mirbft_tpu" / "runtime" / "sub" / "processor.py"
+    fromstyle.parent.mkdir(parents=True)
+    fromstyle.write_text(
+        "from threading import Thread\n"
+        "\n"
+        "def rogue(fn):\n"
+        "    return Thread(target=fn)\n"
+    )
+    assert not any(
+        "Thread" in line for line in lint.check_file(fromstyle)
+    ), "sub/processor.py is not the processor module"
+
+    # Thread creation in *other* runtime modules is out of W10's scope
+    # (the transport legitimately owns its reader/writer threads).
+    other = tmp_path / "mirbft_tpu" / "runtime" / "transport2.py"
+    other.write_text(
+        "import threading\n\ndef f(fn):\n    return threading.Thread(target=fn)\n"
+    )
+    assert not any(
+        "W10" in line and "Thread" in line
+        for line in lint.check_file(other)
+    )
+
+    # The real processor module stays clean.
+    assert not any(
+        "W10" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "runtime" / "processor.py"
+        )
+    )

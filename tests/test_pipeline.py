@@ -1,0 +1,362 @@
+"""Pipelined commit path: group-commit coalescing and the ordering
+contract's fault seams.
+
+The contract under test (docs/Processor.md): no send for a batch may
+happen before that batch's request-store AND WAL data are durable.  The
+pipelined executor enforces it with a barrier stage redeeming group-commit
+tickets; these tests kill the disk (or the transmit stage) at the seam
+between the two syncs and between sync and transmit, and assert that no
+premature send escaped and that a restart replays the WAL cleanly."""
+
+import threading
+import time
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core import actions as act
+from mirbft_tpu.runtime import Config, FileRequestStore, FileWal
+from mirbft_tpu.runtime.processor import PipelinedProcessor, ProcessorClosed
+
+
+# -- harness -----------------------------------------------------------------
+
+
+class _FakeNode:
+    """Just enough Node for a processor: a config, a self-send sink, and
+    an add_results recorder."""
+
+    def __init__(self):
+        self.config = Config(id=0)
+        self.stepped = []
+        self.results = []
+
+    def step(self, replica, msg):
+        self.stepped.append((replica, msg))
+
+    def add_results(self, results):
+        self.results.append(results)
+
+
+class _RecordingLink:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, dest, msg):
+        self.sent.append((dest, msg))
+
+
+class _NullLog:
+    def __init__(self):
+        self.applied = []
+
+    def apply(self, q_entry):
+        self.applied.append(q_entry)
+
+    def snap(self, network_config, clients_state):
+        return b"snap"
+
+
+def _persist_send_actions(index=1):
+    """One batch exercising the full contract: a stored request, a WAL
+    append, and a send that must not escape before both are durable."""
+    ack = pb.RequestAck(client_id=1, req_no=index, digest=b"\x07" * 32)
+    actions = act.Actions()
+    actions.store_request(
+        pb.ForwardRequest(request_ack=ack, request_data=b"payload")
+    )
+    actions.persist(index, pb.Persistent(type=pb.ECEntry(epoch_number=index)))
+    actions.send([1], pb.Msg(type=pb.Suspect(epoch=index)))
+    return actions
+
+
+def _build(tmp_path, wal=None, store=None):
+    node = _FakeNode()
+    link = _RecordingLink()
+    wal = wal if wal is not None else FileWal(str(tmp_path / "wal"))
+    store = (
+        store
+        if store is not None
+        else FileRequestStore(str(tmp_path / "reqs"))
+    )
+    proc = PipelinedProcessor(node, link, _NullLog(), wal, store)
+    return node, link, wal, store, proc
+
+
+def _await_park(proc, deadline_s=5.0):
+    """Wait until a stage error parks the pipeline; return the error."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        with proc._mutex:
+            if proc._error is not None:
+                return proc._error
+        time.sleep(0.01)
+    raise AssertionError("pipeline never parked on the injected fault")
+
+
+# -- group commit ------------------------------------------------------------
+
+
+def test_group_commit_coalesces_fsyncs(tmp_path):
+    """k tickets redeemed across one gated sync window must cost far
+    fewer than k fsyncs (the whole point of sync_token/wait)."""
+    wal = FileWal(str(tmp_path / "wal"))
+    syncs = []
+    gate = threading.Event()
+
+    def hook():
+        syncs.append(time.monotonic())
+        gate.wait(timeout=5.0)
+
+    try:
+        for i in range(5):
+            wal.write(i, pb.Persistent(type=pb.ECEntry(epoch_number=i)))
+        wal.fault_hook = hook
+        tokens = [wal.sync_token() for _ in range(5)]
+        gate.set()
+        for token in tokens:
+            assert wal.wait(token, timeout=5.0)
+        # First sync may cover only the tickets issued before the syncer
+        # snapshotted; one more covers the rest.  Five would mean no
+        # coalescing at all.
+        assert len(syncs) <= 2, f"{len(syncs)} fsyncs for 5 tickets"
+    finally:
+        wal.fault_hook = None
+        wal.close()
+
+
+def test_group_commit_token_covers_earlier_writes(tmp_path):
+    """A single token taken after the last write covers every earlier
+    write — the invariant that lets the pipeline persist a whole group
+    under one ticket pair."""
+    wal = FileWal(str(tmp_path / "wal"))
+    for i in range(10):
+        wal.write(i, pb.Persistent(type=pb.ECEntry(epoch_number=i)))
+    token = wal.sync_token()
+    assert wal.wait(token, timeout=5.0)
+    wal.crash()  # skip the close-time sync: durability came from the ticket
+
+    wal2 = FileWal(str(tmp_path / "wal"))
+    loaded = []
+    wal2.load_all(lambda i, e: loaded.append(i))
+    assert loaded == list(range(10))
+    wal2.close()
+
+
+def test_group_commit_propagates_disk_errors_to_waiters(tmp_path):
+    """A failing fsync must surface on wait() (and poison later tokens),
+    never silently report durability."""
+    store = FileRequestStore(str(tmp_path / "reqs"))
+    ack = pb.RequestAck(client_id=1, req_no=1, digest=b"\x01" * 32)
+    store.store(ack, b"data")
+
+    def dying_disk():
+        raise OSError("injected: disk died")
+
+    store.fault_hook = dying_disk
+    token = store.sync_token()
+    with pytest.raises(OSError, match="disk died"):
+        store.wait(token, timeout=5.0)
+    with pytest.raises(OSError):
+        store.sync_token()
+    store.fault_hook = None
+    store.crash()
+
+
+def test_group_commit_crash_close_fails_uncovered_tickets(tmp_path):
+    """crash() must leave outstanding tickets uncovered (waiters get an
+    error, not a durability lie); clean close() covers them."""
+    wal = FileWal(str(tmp_path / "wal"))
+    wal.write(1, pb.Persistent(type=pb.ECEntry(epoch_number=1)))
+    block = threading.Event()
+    wal.fault_hook = lambda: block.wait(timeout=0.2)
+    token = wal.sync_token()
+    wal.fault_hook = None
+    wal.crash()
+    with pytest.raises(OSError):
+        wal.wait(token, timeout=5.0)
+
+    wal2 = FileWal(str(tmp_path / "wal"))
+    wal2.write(2, pb.Persistent(type=pb.ECEntry(epoch_number=2)))
+    token = wal2.sync_token()
+    wal2.close()  # clean close: final sync covers the ticket
+    assert wal2.wait(token, timeout=5.0)
+
+
+# -- pipeline ordering-contract fault seams ----------------------------------
+
+
+def test_no_send_escapes_when_wal_sync_fails(tmp_path):
+    """Disk dies at the WAL sync (after the request store persisted):
+    the barrier must hold every send of that batch, the error must
+    surface from a later process() call, and a fresh WAL on the same
+    directory must replay a clean prefix."""
+    wal = FileWal(str(tmp_path / "wal"))
+    node, link, wal, store, proc = _build(tmp_path, wal=wal)
+
+    def dying_disk():
+        raise OSError("injected: WAL disk died")
+
+    try:
+        wal.fault_hook = dying_disk
+        proc.process(_persist_send_actions(1))
+        err = _await_park(proc)
+        assert "WAL disk died" in str(err)
+        # The contract: nothing was sent for the un-durable batch.
+        assert link.sent == []
+        assert node.stepped == []
+        with pytest.raises(OSError, match="WAL disk died"):
+            proc.process(_persist_send_actions(2))
+    finally:
+        proc.close(wait=False)
+        wal.fault_hook = None
+        store.crash()
+        wal.crash()
+
+    # Restart replays cleanly: whatever prefix survived parses.
+    wal2 = FileWal(str(tmp_path / "wal"))
+    loaded = []
+    wal2.load_all(lambda i, e: loaded.append(i))
+    wal2.close()
+    store2 = FileRequestStore(str(tmp_path / "reqs"))
+    uncommitted = []
+    store2.uncommitted(uncommitted.append)
+    store2.close()
+
+
+def test_no_send_escapes_when_reqstore_sync_fails(tmp_path):
+    """Disk dies at the request-store sync (before the WAL's): same
+    contract — the batch's sends never leave the barrier."""
+    store = FileRequestStore(str(tmp_path / "reqs"))
+    node, link, wal, store, proc = _build(tmp_path, store=store)
+
+    def dying_disk():
+        raise OSError("injected: reqstore disk died")
+
+    try:
+        store.fault_hook = dying_disk
+        proc.process(_persist_send_actions(1))
+        err = _await_park(proc)
+        assert "reqstore disk died" in str(err)
+        assert link.sent == []
+        assert node.stepped == []
+        with pytest.raises(OSError, match="reqstore disk died"):
+            proc.process(_persist_send_actions(2))
+    finally:
+        proc.close(wait=False)
+        store.fault_hook = None
+        store.crash()
+        wal.crash()
+
+    wal2 = FileWal(str(tmp_path / "wal"))
+    loaded = []
+    wal2.load_all(lambda i, e: loaded.append(i))
+    wal2.close()
+
+
+def test_crash_between_wal_sync_and_transmit_replays(tmp_path):
+    """Process dies between the durability barrier and the sends: the
+    WAL must already hold the batch (it was durable before transmit was
+    ever attempted), and zero sends escaped — exactly the window WAL
+    replay exists for."""
+    node, link, wal, store, proc = _build(tmp_path)
+
+    def crashing_transmit(actions):
+        raise RuntimeError("injected: crashed before transmit")
+
+    proc._transmit = crashing_transmit
+    try:
+        proc.process(_persist_send_actions(1))
+        err = _await_park(proc)
+        assert "crashed before transmit" in str(err)
+        assert link.sent == []
+        assert node.stepped == []
+    finally:
+        proc.close(wait=False)
+        store.crash()
+        wal.crash()
+
+    # The batch IS in the WAL: durability preceded the crash point.
+    wal2 = FileWal(str(tmp_path / "wal"))
+    loaded = []
+    wal2.load_all(lambda i, e: loaded.append(i))
+    assert loaded == [1]
+    wal2.close()
+
+
+def test_send_happens_only_after_both_stores_durable(tmp_path):
+    """Happy path: the send arrives, and only after both group-commit
+    tickets were redeemable (observed via gated fault hooks)."""
+    node, link, wal, store, proc = _build(tmp_path)
+    sync_times = {}
+
+    def observing(name):
+        def hook():
+            sync_times.setdefault(name, time.monotonic())
+
+        return hook
+
+    wal.fault_hook = observing("wal")
+    store.fault_hook = observing("store")
+    try:
+        proc.process(_persist_send_actions(1))
+        deadline = time.monotonic() + 5.0
+        while not link.sent and time.monotonic() < deadline:
+            time.sleep(0.005)
+        send_time = time.monotonic()
+        assert link.sent, "send never happened"
+        assert {"wal", "store"} <= set(sync_times), (
+            f"send escaped without both syncs: {sorted(sync_times)}"
+        )
+        assert max(sync_times.values()) <= send_time
+    finally:
+        wal.fault_hook = None
+        store.fault_hook = None
+        proc.close()
+        store.close()
+        wal.close()
+
+
+def test_pipeline_delivers_results_internally(tmp_path):
+    """process() returns empty results; digests (hash worker) and
+    checkpoint values (commit stage) arrive via node.add_results, and
+    the on_results seam sees them first."""
+    node, link, wal, store, proc = _build(tmp_path)
+    seen = []
+    proc.on_results = seen.append
+    try:
+        actions = act.Actions()
+        actions.hash([b"preimage"], None)
+        actions.commits.append(
+            act.CommitAction(
+                checkpoint=act.CheckpointReq(
+                    seq_no=10, network_config=None, clients_state=[]
+                )
+            )
+        )
+        out = proc.process(actions)
+        assert not out.digests and not out.checkpoints
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            digests = [d for r in node.results for d in r.digests]
+            ckpts = [c for r in node.results for c in r.checkpoints]
+            if digests and ckpts:
+                break
+            time.sleep(0.005)
+        assert len(digests) == 1 and len(digests[0].digest) == 32
+        assert ckpts[0].value == b"snap"
+        assert seen, "on_results seam never fired"
+    finally:
+        proc.close()
+        store.close()
+        wal.close()
+
+
+def test_closed_processor_rejects_new_batches(tmp_path):
+    node, link, wal, store, proc = _build(tmp_path)
+    proc.close()
+    with pytest.raises(ProcessorClosed):
+        proc.process(_persist_send_actions(1))
+    store.close()
+    wal.close()

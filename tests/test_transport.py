@@ -680,3 +680,83 @@ def test_clock_sync_hello_records_offset():
     finally:
         sender.close()
         receiver.close()
+
+
+def test_nodelay_set_on_both_directions_of_established_pair():
+    """TCP_NODELAY must hold on the dialed socket AND the accepted one:
+    Nagle is per-direction, so a sender-only option still leaves the
+    accept side delaying its ACK-piggybacked writes."""
+    import socket as socketlib
+
+    received = []
+
+    class _Sink:
+        def step(self, source, msg):
+            received.append(source)
+
+    sender = TcpTransport(0)
+    receiver = TcpTransport(1)
+    try:
+        receiver.serve(_Sink())
+        sender.connect(1, receiver.address)
+        sender.link().send(1, pb.Msg(type=pb.Suspect(epoch=1)))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and (
+            1 not in sender._conns or not receiver._accepted
+        ):
+            time.sleep(0.01)
+        assert 1 in sender._conns, "dial never completed"
+        assert receiver._accepted, "accept never completed"
+
+        dialed, _lock = sender._conns[1]
+        accepted = next(iter(receiver._accepted))
+        for sock, which in ((dialed, "dialed"), (accepted, "accepted")):
+            assert (
+                sock.getsockopt(socketlib.IPPROTO_TCP, socketlib.TCP_NODELAY)
+                != 0
+            ), f"TCP_NODELAY not set on the {which} socket"
+    finally:
+        sender.close()
+        receiver.close()
+
+
+def test_frame_encoder_scratch_matches_naive_encoding_and_is_not_slower():
+    """The bytearray-scratch frame encoder must emit byte-identical
+    frames to the naive two-allocation spelling, and the reuse must not
+    lose to it (micro-benchmark with generous slack — the point is to
+    catch an accidental O(n^2) or per-call reallocation regression, not
+    to assert microseconds)."""
+    import struct
+
+    t = TcpTransport(0)
+    try:
+        _len = struct.Struct("<I")  # must match transport._LEN
+        msgs = [
+            pb.Msg(type=pb.Suspect(epoch=e)) for e in range(8)
+        ]
+
+        def naive(msg):
+            payload = t._src_prefix + pb.encode(msg)
+            return _len.pack(len(payload)) + payload
+
+        for msg in msgs:
+            assert t._encode_frame(msg) == naive(msg)
+
+        n = 3000
+        start = time.perf_counter()
+        for _ in range(n):
+            for msg in msgs:
+                naive(msg)
+        naive_s = time.perf_counter() - start
+        start = time.perf_counter()
+        for _ in range(n):
+            for msg in msgs:
+                t._encode_frame(msg)
+        scratch_s = time.perf_counter() - start
+        # 2x slack: CI boxes are noisy; the scratch encoder losing by
+        # more than that means the reuse regressed into fresh copies.
+        assert scratch_s < naive_s * 2.0, (
+            f"scratch encoder {scratch_s:.4f}s vs naive {naive_s:.4f}s"
+        )
+    finally:
+        t.close()

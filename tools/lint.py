@@ -29,6 +29,16 @@ enforces the defect classes that have actually bitten BFT codebases:
   live chaos driver's partition proxies; a stray socket elsewhere
   bypasses every one of those disciplines.  Scoped to ``mirbft_tpu/``
   (tests and tools may open sockets freely).
+- W10 durability/pipeline discipline, two prongs.  (a) ``os.fsync``
+  outside ``mirbft_tpu/runtime/storage.py`` and the live chaos
+  driver's durable app log — the stores' group-commit coalescer is the
+  only fsync authority; a stray fsync elsewhere silently reintroduces
+  the per-batch sync cost the pipelined commit path exists to amortize.
+  (b) raw ``threading.Thread`` creation in
+  ``mirbft_tpu/runtime/processor.py`` outside the pipeline's
+  ``_spawn_stage`` helper — stage threads must go through the single
+  creation point so naming (``proc-pipe-*``), daemonization, and the
+  leak gate stay uniform.  Scoped to ``mirbft_tpu/``.
 
 Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
 Also enforced in CI-equivalent form by ``tests/test_lint.py``.
@@ -134,6 +144,40 @@ def _in_socket_ban_scope(path: Path) -> bool:
     )
 
 
+# The only files allowed to call os.fsync: the stores own the
+# group-commit coalescer, and the live chaos driver's durable app log
+# models an application fsyncing its own state (deliberately outside the
+# group-commit path, like a real app would be).
+FSYNC_ALLOWED_FILES = (
+    "mirbft_tpu/runtime/storage.py",
+    "mirbft_tpu/chaos/live.py",
+)
+
+# The one module (and the one helper inside it) allowed to create
+# pipeline threads.
+THREAD_BAN_FILE = "mirbft_tpu/runtime/processor.py"
+THREAD_SPAWN_HELPER = "_spawn_stage"
+
+
+def _in_fsync_ban_scope(path: Path) -> bool:
+    """True for mirbft_tpu files where W10 bans ``os.fsync``."""
+    posix = path.resolve().as_posix()
+    return "mirbft_tpu/" in posix and not any(
+        posix.endswith(allowed) for allowed in FSYNC_ALLOWED_FILES
+    )
+
+
+def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
+    """Line spans of every ``_spawn_stage`` definition (the only place
+    W10 permits ``threading.Thread(...)`` in the processor module)."""
+    return [
+        (node.lineno, node.end_lineno or node.lineno)
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name == THREAD_SPAWN_HELPER
+    ]
+
+
 def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
     """Lint one file.  ``monotonic_only`` forces the W7 wall-clock check
     on (True) or off (False); None scopes it by MONOTONIC_ONLY_TREES."""
@@ -157,6 +201,9 @@ def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
         if is_package_init:
             continue  # package __init__ imports are the public surface
         findings.append(f"{path}:{line}: W1 unused import '{what}'")
+
+    in_thread_ban_file = path.resolve().as_posix().endswith(THREAD_BAN_FILE)
+    spawn_spans = _spawn_helper_spans(tree) if in_thread_ban_file else []
 
     # Format specs (the ``:6d`` in an f-string) are themselves JoinedStr
     # nodes; they must not trip the W6 empty-f-string check.
@@ -256,6 +303,39 @@ def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
                     "runtime/transport.py and chaos/live.py (wire I/O "
                     "goes through the transport or the live driver's "
                     "partition proxies)"
+                )
+        if _in_fsync_ban_scope(path):
+            hit = (
+                isinstance(node, ast.Attribute)
+                and node.attr == "fsync"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "os"
+            ) or (
+                isinstance(node, ast.ImportFrom)
+                and node.module == "os"
+                and any(alias.name == "fsync" for alias in node.names)
+            )
+            if hit:
+                findings.append(
+                    f"{path}:{node.lineno}: W10 os.fsync outside "
+                    "runtime/storage.py (durability goes through the "
+                    "stores' sync()/sync_token() group-commit API)"
+                )
+        if in_thread_ban_file and isinstance(node, ast.Call):
+            func = node.func
+            hit = (
+                isinstance(func, ast.Attribute)
+                and func.attr == "Thread"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "threading"
+            ) or (isinstance(func, ast.Name) and func.id == "Thread")
+            if hit and not any(
+                lo <= node.lineno <= hi for lo, hi in spawn_spans
+            ):
+                findings.append(
+                    f"{path}:{node.lineno}: W10 raw threading.Thread in "
+                    "runtime/processor.py outside _spawn_stage (stage "
+                    "threads go through the single creation point)"
                 )
 
     return findings
