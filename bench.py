@@ -105,6 +105,19 @@ LIVE_SUSPECT_TICKS = 10_000
 LIVE_FSYNC_FLOOR_S = 0.040
 LIVE_DEADLINE_S = 120.0
 
+# Multi-process rung: the same 4-node consensus, but one real OS process
+# per node (cluster/ supervisor + workers) under open-loop Poisson load
+# from the loadgen package, stepped through LIVE_MP_RATE_STEPS offered
+# rates.  Unlike the time-to-target live rung above, this one measures
+# the latency *distribution* under a fixed offered rate — the SLO view —
+# and emits a mirbft-loadgen-slo artifact under the payload's "loadgen"
+# key that `obsv --diff` gates run-to-run.
+LIVE_MP_NODES = 4
+LIVE_MP_RATE_STEPS = (25.0, 50.0, 100.0)
+LIVE_MP_STEP_DURATION_S = 2.0
+LIVE_MP_DRAIN_S = 25.0
+LIVE_MP_BATCH_SIZE = 4
+
 
 def kernel_microbench():
     import hashlib
@@ -683,6 +696,46 @@ def live_cluster_rate(kind: str) -> float:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def live_mp_run(kind: str):
+    """One open-loop load run against a real multi-process cluster
+    under executor ``kind``: LIVE_MP_NODES worker processes, Poisson
+    arrivals stepped through LIVE_MP_RATE_STEPS, the standard hostile
+    client mix (honest / slow+mixed-size / retry-storm).  Returns
+    ``(steps, goodput_at_top_rate, p95_at_top_rate)`` where ``steps``
+    are loadgen StepResults ready for the SLO artifact."""
+    from mirbft_tpu import loadgen
+    from mirbft_tpu.cluster import ClusterSupervisor
+
+    client_ids = [1, 2, 3]
+    supervisor = ClusterSupervisor(
+        node_count=LIVE_MP_NODES,
+        client_ids=client_ids,
+        batch_size=LIVE_MP_BATCH_SIZE,
+        processor=kind,
+    )
+    try:
+        supervisor.start()
+        generator = loadgen.LoadGenerator(
+            supervisor,
+            loadgen.standard_client_models(client_ids),
+            seed=11,
+        )
+        steps = []
+        for rate in LIVE_MP_RATE_STEPS:
+            steps.append(
+                generator.run_step(
+                    f"{kind}-poisson-{int(rate)}",
+                    loadgen.PoissonArrivals(rate, seed=int(rate)),
+                    duration_s=LIVE_MP_STEP_DURATION_S,
+                    drain_s=LIVE_MP_DRAIN_S,
+                )
+            )
+        top = steps[-1]
+        return steps, top.goodput_per_sec, top.p95_ms
+    finally:
+        supervisor.teardown()
+
+
 class StageRunner:
     """Time-boxed stage executor under one monotonic deadline.
 
@@ -907,6 +960,19 @@ def main() -> int:
     live_pipelined = runner.run(
         "live_pipelined", lambda: live_cluster_rate("pipelined")
     )
+    mp_serial = runner.run("live_mp_serial", lambda: live_mp_run("serial"))
+    mp_pipelined = runner.run(
+        "live_mp_pipelined", lambda: live_mp_run("pipelined")
+    )
+    mp_steps = []
+    mp_serial_goodput = mp_serial_p95 = None
+    if mp_serial is not None:
+        steps, mp_serial_goodput, mp_serial_p95 = mp_serial
+        mp_steps.extend(steps)
+    mp_pipelined_goodput = mp_pipelined_p95 = None
+    if mp_pipelined is not None:
+        steps, mp_pipelined_goodput, mp_pipelined_p95 = mp_pipelined
+        mp_steps.extend(steps)
 
     def warm_calibrate():
         _enable_compile_cache()
@@ -1013,6 +1079,22 @@ def main() -> int:
             "WAL/reqstore, emulated flush latency "
             f"{LIVE_FSYNC_FLOOR_S * 1e3:.0f}ms/fsync"
         ),
+        # Multi-process rung: real worker processes under stepped
+        # open-loop Poisson load; headline numbers are the top rate
+        # step's goodput and p95 latency, and the full per-step SLO
+        # artifact rides under "loadgen" (obsv --diff flattens it to
+        # loadgen.step.* series and gates p95/goodput regressions).
+        "live_mp_goodput_per_sec_serial": _round(mp_serial_goodput),
+        "live_mp_p95_ms_serial": _round(mp_serial_p95, 2),
+        "live_mp_goodput_per_sec_pipelined": _round(mp_pipelined_goodput),
+        "live_mp_p95_ms_pipelined": _round(mp_pipelined_p95, 2),
+        "live_mp_config": (
+            f"{LIVE_MP_NODES} worker processes, open-loop Poisson at "
+            f"{'/'.join(str(int(r)) for r in LIVE_MP_RATE_STEPS)} req/s "
+            f"x {LIVE_MP_STEP_DURATION_S:.0f}s, "
+            f"batch_size={LIVE_MP_BATCH_SIZE}, client mix: honest + "
+            "slow/mixed-size + retry-storm"
+        ),
         "unit": "reqs/s",
         "vs_baseline": (
             round(host_wall / tpu_wall, 3) if tpu_wall and host_wall else None
@@ -1080,6 +1162,15 @@ def main() -> int:
         "stages": runner.stage_report(),
         "engine_gauges": _engine_gauges(registry),
     }
+    if mp_steps:
+        from mirbft_tpu import loadgen
+
+        payload["loadgen"] = loadgen.artifact(
+            mp_steps,
+            cluster="mp",
+            nodes=LIVE_MP_NODES,
+            rate_steps=list(LIVE_MP_RATE_STEPS),
+        )
     if plane is not None:
         payload.update(
             {

@@ -1,9 +1,12 @@
 """CLI entry: ``python -m mirbft_tpu.chaos [--seed N] [--seeds K] [--smoke]
-[--live] [--only S]``.
+[--live] [--cluster {threads,mp}] [--only S]``.
 
 ``--live`` runs the campaign against a real loopback TCP cluster
-(chaos/live.py) instead of the deterministic testengine; ``--smoke``
-selects each mode's tier-1 subset.
+instead of the deterministic testengine; ``--smoke`` selects each
+mode's tier-1 subset.  ``--cluster`` picks the live cluster shape:
+``threads`` (default, chaos/live.py — every node in this process) or
+``mp`` (cluster/chaos_mp.py — one OS process per node, SIGKILL
+crashes, restart-from-disk, socket-proxy partitions).
 
 Exit status 0 iff every selected scenario passed all invariants (under
 every seed of the sweep, when ``--seeds`` > 1)."""
@@ -46,6 +49,15 @@ def main(argv=None) -> int:
         "sockets, fsyncs) instead of the deterministic testengine",
     )
     parser.add_argument(
+        "--cluster",
+        default="threads",
+        choices=("threads", "mp"),
+        help="live cluster shape (--live only): threads = all nodes in "
+        "this process (default); mp = one OS process per node via the "
+        "cluster supervisor (true kill -9, restart-from-disk, proxied "
+        "partitions)",
+    )
+    parser.add_argument(
         "--only",
         default=None,
         help="run only scenarios whose name contains this substring",
@@ -71,7 +83,13 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    if args.live:
+    if args.live and args.cluster == "mp":
+        # The mp matrix is already the smoke-sized pair + the dedup
+        # storm; process-per-node runs are too heavy for a long matrix.
+        from ..cluster.chaos_mp import mp_matrix
+
+        scenarios = mp_matrix()
+    elif args.live:
         scenarios = live_smoke_matrix() if args.smoke else live_matrix()
     else:
         scenarios = smoke_matrix() if args.smoke else matrix()
@@ -91,7 +109,16 @@ def main(argv=None) -> int:
     all_passed = True
     good_campaigns = 0
     for seed in range(args.seed, args.seed + args.seeds):
-        if args.live:
+        if args.live and args.cluster == "mp":
+            from ..cluster.chaos_mp import run_mp_campaign
+
+            campaign = run_mp_campaign(
+                scenarios,
+                seed=seed,
+                budget_s=max(args.budget, 180.0),
+                processor=args.processor,
+            )
+        elif args.live:
             campaign = run_live_campaign(
                 scenarios,
                 seed=seed,

@@ -267,10 +267,16 @@ class DurableChainLog(Log):
     the skipped range stays absent (adopted, not individually committed).
     """
 
-    def __init__(self, path: str, node_id: int, on_commit=None):
+    def __init__(
+        self, path: str, node_id: int, on_commit=None, timestamps=False
+    ):
         self.path = path
         self.node_id = node_id
         self.on_commit = on_commit
+        # Stamp apply records with monotonic ns (CLOCK_MONOTONIC is
+        # system-wide on one host, so a loadgen process on the same
+        # machine computes submit→commit latency by subtraction).
+        self.timestamps = timestamps
         self.chain = b""
         self.commits: list = []  # [(client_id, req_no, seq_no)]
         self.last_seq = 0
@@ -311,14 +317,15 @@ class DurableChainLog(Log):
             self.commits.append((ack.client_id, ack.req_no, q_entry.seq_no))
             reqs.append((ack.client_id, ack.req_no, ack.digest.hex()))
         self.last_seq = q_entry.seq_no
-        self._record(
-            {
-                "t": "apply",
-                "seq": q_entry.seq_no,
-                "reqs": reqs,
-                "chain": self.chain.hex(),
-            }
-        )
+        rec = {
+            "t": "apply",
+            "seq": q_entry.seq_no,
+            "reqs": reqs,
+            "chain": self.chain.hex(),
+        }
+        if self.timestamps:
+            rec["ts_ns"] = time.monotonic_ns()
+        self._record(rec)
         if reqs and self.on_commit is not None:
             self.on_commit(self.node_id, len(reqs))
 

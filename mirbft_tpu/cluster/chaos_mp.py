@@ -1,0 +1,396 @@
+"""Chaos scenarios against a real multi-process cluster.
+
+``run_mp_scenario`` is the ``--cluster mp`` lowering of
+``chaos.live.run_live_scenario``: the same ``Scenario`` schema, the same
+invariant checkers, but each node is a separate OS process under
+``ClusterSupervisor``.  Crash points become SIGKILL (true kill -9, not
+the in-process approximation), restarts respawn the worker from its
+on-disk WAL/reqstore on the same port, and partition windows cut the
+supervisor's socket proxies.
+
+Evidence is read from the outside only — the supervisor tails each
+node's fsynced app.log — so the audit holds exactly what a crashed
+process left on disk, with no in-process shortcuts.
+
+The client load doubles as a retry storm: every request is submitted to
+*every* live node, and uncommitted requests are re-submitted on a short
+period until convergence.  Request dedup (the client-window watermarks)
+must absorb all of it; ``check_no_fork`` fails any scenario in which a
+``(client_id, req_no)`` pair commits twice on any node, and the
+dedicated ``retry-storm-dedup`` scenario additionally asserts the
+exactly-once count while reporting how many duplicate submissions the
+cluster absorbed.
+
+Not every live-scenario feature lowers to processes: storage-fault
+injection and signed mode need in-process seams, and ``drop_pct``'s
+``TransportFault`` lives inside each worker — scenarios using those are
+rejected rather than silently weakened.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+from .. import pb
+from ..chaos.invariants import (
+    CrashSnapshot,
+    InvariantViolation,
+    check_bounded_recovery,
+    check_commit_resumption,
+    check_durable_prefix,
+    check_no_fork,
+)
+from ..chaos.live import MIN_RECOVERY_BOUND_MS, SIM_TICK_MS
+from ..chaos.runner import CampaignResult, ScenarioResult
+from ..chaos.scenarios import Scenario, live_smoke_matrix
+from .supervisor import ClusterSupervisor
+
+# The mp acceptance pair: a true kill -9 + restart-from-disk, and a
+# proxied minority partition with heal — plus the dedup storm.
+MP_SMOKE_NAMES = ("crash-restart", "partition-minority")
+
+
+def retry_storm_scenario() -> Scenario:
+    """No faults, maximum client hostility: every request submitted to
+    every node and re-submitted aggressively until the cluster converges.
+    The pass condition is exactly-once commitment everywhere."""
+    return Scenario(
+        name="retry-storm-dedup",
+        description=(
+            "duplicate-heavy open retry storm; dedup must absorb every "
+            "resubmission"
+        ),
+        node_count=4,
+        client_count=2,
+        reqs_per_client=6,
+    )
+
+
+def mp_matrix() -> list:
+    """Scenarios run under ``chaos --live --cluster mp``."""
+    by_name = {s.name: s for s in live_smoke_matrix()}
+    return [by_name[name] for name in MP_SMOKE_NAMES] + [
+        retry_storm_scenario()
+    ]
+
+
+def _reject_unsupported(scenario: Scenario) -> None:
+    unsupported = []
+    if scenario.storage_faults:
+        unsupported.append("storage_faults")
+    if scenario.signed:
+        unsupported.append("signed")
+    if scenario.drop_pct:
+        unsupported.append("drop_pct")
+    if unsupported:
+        raise ValueError(
+            f"scenario {scenario.name!r} uses {', '.join(unsupported)}, "
+            "which need in-process seams; run it under --cluster threads"
+        )
+
+
+class _MpDriver:
+    """One scenario against one multi-process cluster."""
+
+    def __init__(
+        self,
+        scenario: Scenario,
+        tick_seconds: float,
+        budget_s: float,
+        max_reqs_per_client: int,
+        processor: str,
+        retry_period_s: float = 0.3,
+    ):
+        self.scenario = scenario
+        self.tick_seconds = tick_seconds
+        self.budget_s = budget_s
+        self.reqs_per_client = min(
+            scenario.reqs_per_client, max_reqs_per_client
+        )
+        self.clients = list(range(1, scenario.client_count + 1))
+        self.retry_period_s = retry_period_s
+        self.supervisor = ClusterSupervisor(
+            node_count=scenario.node_count,
+            client_ids=self.clients,
+            batch_size=scenario.batch_size,
+            processor=processor,
+            tick_seconds=tick_seconds,
+            proxied=bool(scenario.partitions),
+        )
+        self.expected = {
+            (client_id, req_no)
+            for client_id in self.clients
+            for req_no in range(self.reqs_per_client)
+        }
+        # The dedup scenario must not depend on racing the commit path:
+        # every first-pass submission is itself repeated, so duplicates
+        # reach the cluster even when it converges before a retry fires.
+        self.storm_repeat = 3 if scenario.name == "retry-storm-dedup" else 1
+        self._start = None
+        self.down: set = set()  # crashed, restart still pending
+        self.snapshots: list = []
+        self.commit_times_ms: list = []
+        self.heal_times_ms: list = []
+        self.events_fired = 0
+        self.resubmissions = 0
+        self._proposer_stop = threading.Event()
+        self._proposer = None
+
+    # -- time ----------------------------------------------------------------
+
+    def scale_s(self, sim_ms: int) -> float:
+        return sim_ms / SIM_TICK_MS * self.tick_seconds
+
+    def now_ms(self) -> int:
+        return int((time.monotonic() - self._start) * 1000)
+
+    # -- client load ---------------------------------------------------------
+
+    def _submit(self, client_id: int, req_no: int, first: bool) -> None:
+        request = pb.Request(
+            client_id=client_id, req_no=req_no, data=b"%d" % req_no
+        )
+        repeat = self.storm_repeat if first else 1
+        for round_no in range(repeat):
+            for node_id in self.supervisor.alive_nodes():
+                self.supervisor.submit(node_id, request)
+                if not first or round_no > 0:
+                    self.resubmissions += 1
+
+    def _propose_all(self, last_event_s: float) -> None:
+        ordered = sorted(self.expected)
+        # Pace the first pass past the final fault instant so every
+        # disruption lands mid-traffic (see LiveCluster._propose_all).
+        span_s = max(last_event_s * 1.25, 0.4)
+        gap = span_s / max(len(ordered), 1)
+        for client_id, req_no in ordered:
+            if self._proposer_stop.wait(gap):
+                return
+            self._submit(client_id, req_no, first=True)
+        # The retry storm: keep re-submitting whatever a node has not yet
+        # committed; watermark dedup must absorb all of it.
+        while not self._proposer_stop.wait(self.retry_period_s):
+            committed = set()
+            for handle in self.supervisor.nodes:
+                committed |= {(c, q) for c, q, _s in handle.commits}
+            for client_id, req_no in ordered:
+                if (client_id, req_no) not in committed:
+                    self._submit(client_id, req_no, first=False)
+
+    # -- fault schedule ------------------------------------------------------
+
+    def schedule(self) -> list:
+        events = []
+        for window in self.scenario.partitions:
+            events.append(
+                (self.scale_s(window.from_ms), 0, "cut", window.groups)
+            )
+            events.append(
+                (self.scale_s(window.until_ms), 1, "heal", window.groups)
+            )
+        for point in self.scenario.crashes:
+            events.append((self.scale_s(point.at_ms), 2, "crash", point.node))
+            events.append(
+                (
+                    self.scale_s(point.at_ms + point.restart_delay_ms),
+                    3,
+                    "restart",
+                    point.node,
+                )
+            )
+        events.sort(key=lambda e: (e[0], e[1]))
+        return events
+
+    def _fire(self, kind: str, payload) -> None:
+        if kind == "cut":
+            self.supervisor.set_partition(payload, True)
+        elif kind == "heal":
+            self.supervisor.set_partition(payload, False)
+            self.heal_times_ms.append(self.now_ms())
+        elif kind == "crash":
+            self.supervisor.poll_commits()
+            self.snapshots.append(
+                CrashSnapshot(
+                    node=payload,
+                    at_ms=self.now_ms(),
+                    committed=list(self.supervisor.nodes[payload].commits),
+                )
+            )
+            self.down.add(payload)
+            self.supervisor.kill(payload, graceful=False)
+        elif kind == "restart":
+            self.supervisor.restart(payload)
+            self.down.discard(payload)
+            self.heal_times_ms.append(self.now_ms())
+
+    def _reap(self) -> None:
+        for handle in self.supervisor.nodes:
+            if handle.node_id in self.down:
+                continue
+            if handle.process is not None and not handle.alive:
+                raise InvariantViolation(
+                    f"node {handle.node_id} process died without an "
+                    f"injected crash (rc={handle.process.returncode}):\n"
+                    f"{handle.log_tail()}"
+                )
+
+    def _converged(self) -> bool:
+        if self.down:
+            return False
+        full = False
+        chains = set()
+        for handle in self.supervisor.nodes:
+            if not handle.alive:
+                return False
+            pairs = {(c, q) for c, q, _s in handle.commits}
+            if self.expected <= pairs:
+                full = True
+            chains.add(handle.chain)
+        return full and len(chains) == 1 and "" not in chains
+
+    # -- the drive loop ------------------------------------------------------
+
+    def run(self) -> int:
+        self.supervisor.start()
+        self._start = time.monotonic()
+        events = self.schedule()
+        last_event_s = events[-1][0] if events else 0.0
+        self._proposer = threading.Thread(
+            target=self._propose_all,
+            args=(last_event_s,),
+            name="chaos-mp-proposer",
+            daemon=True,
+        )
+        self._proposer.start()
+        deadline = self._start + self.budget_s
+        while time.monotonic() < deadline:
+            now_s = time.monotonic() - self._start
+            while events and events[0][0] <= now_s:
+                _at, _order, kind, payload = events.pop(0)
+                self.events_fired += 1
+                self._fire(kind, payload)
+            if self.supervisor.poll_commits():
+                self.commit_times_ms.append(self.now_ms())
+            self._reap()
+            if not events and self._converged():
+                return self.now_ms()
+            time.sleep(0.02)
+        commits = [len(h.commits) for h in self.supervisor.nodes]
+        raise InvariantViolation(
+            f"no convergence within the {self.budget_s:.0f}s budget "
+            f"(per-node commits: {commits}, nodes down: {sorted(self.down)}, "
+            f"events unfired: {len(events)})"
+        )
+
+    def evidence(self) -> SimpleNamespace:
+        self.supervisor.poll_commits()
+        return SimpleNamespace(
+            node_count=self.scenario.node_count,
+            node_states=[
+                SimpleNamespace(
+                    committed_reqs=list(handle.commits),
+                    app_chain=handle.chain,
+                    crashed=False,
+                )
+                for handle in self.supervisor.nodes
+            ],
+        )
+
+    def teardown(self) -> None:
+        self._proposer_stop.set()
+        if self._proposer is not None and self._proposer.ident is not None:
+            self._proposer.join(timeout=10)
+        self.supervisor.teardown()
+
+
+def run_mp_scenario(
+    scenario: Scenario,
+    seed: int = 0,
+    tick_seconds: float = 0.04,
+    budget_s: float = 180.0,
+    max_reqs_per_client: int = 8,
+    processor: str = "serial",
+) -> ScenarioResult:
+    """Execute one scenario against a real multi-process cluster and
+    audit every invariant; violations are reported in the result, never
+    raised (harness bugs still propagate)."""
+    _reject_unsupported(scenario)
+    result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
+    driver = _MpDriver(
+        scenario, tick_seconds, budget_s, max_reqs_per_client, processor
+    )
+    try:
+        try:
+            converged_ms = driver.run()
+            heals = driver.heal_times_ms
+            last_heal = max(heals) if heals else 0
+            bound_ms = max(
+                int(driver.scale_s(scenario.recovery_bound_ms) * 1000),
+                MIN_RECOVERY_BOUND_MS,
+            )
+            result.counters["recovery_ms"] = converged_ms - last_heal
+            check_bounded_recovery(converged_ms, last_heal, bound_ms)
+            if heals:
+                check_commit_resumption(
+                    driver.commit_times_ms, last_heal, bound_ms
+                )
+            evidence = driver.evidence()
+            check_no_fork(evidence)
+            check_durable_prefix(evidence, driver.snapshots)
+            if scenario.name == "retry-storm-dedup":
+                if driver.resubmissions == 0:
+                    raise InvariantViolation(
+                        "the retry storm never submitted a duplicate — "
+                        "the scenario proved nothing"
+                    )
+                # Exactly-once, strictly: the storm must not inflate any
+                # node's log past one commit per unique request.
+                for state in evidence.node_states:
+                    pairs = [(c, q) for c, q, _s in state.committed_reqs]
+                    extra = len(pairs) - len(driver.expected)
+                    if extra > 0:
+                        raise InvariantViolation(
+                            f"retry storm leaked {extra} duplicate "
+                            "commits into a node's log"
+                        )
+                result.counters["resubmissions"] = driver.resubmissions
+            result.passed = True
+        except InvariantViolation as violation:
+            result.violation = str(violation)
+        result.events = driver.events_fired
+        result.sim_ms = driver.now_ms() if driver._start is not None else 0
+        result.commits = sum(
+            len(handle.commits) for handle in driver.supervisor.nodes
+        )
+        if driver.snapshots:
+            result.counters["crashes"] = len(driver.snapshots)
+    finally:
+        driver.teardown()
+    return result
+
+
+def run_mp_campaign(
+    scenarios: list | None = None,
+    seed: int = 0,
+    tick_seconds: float = 0.04,
+    budget_s: float = 180.0,
+    processor: str = "serial",
+) -> CampaignResult:
+    """Run a scenario list (default: the mp matrix) against real
+    multi-process clusters, one at a time."""
+    if scenarios is None:
+        scenarios = mp_matrix()
+    campaign = CampaignResult(seed=seed)
+    for index, scenario in enumerate(scenarios):
+        campaign.results.append(
+            run_mp_scenario(
+                scenario,
+                seed=seed + index,
+                tick_seconds=tick_seconds,
+                budget_s=budget_s,
+                processor=processor,
+            )
+        )
+    return campaign

@@ -1,0 +1,469 @@
+"""The cluster supervisor: spawn, wire, probe, kill, restart, tear down.
+
+``ClusterSupervisor`` runs an N-node mirbft-tpu cluster as N real OS
+processes (``python -m mirbft_tpu.cluster`` workers) under one scratch
+root, one directory per node (spec.json, address.json, peers.json,
+worker.log, wal/, reqs/, app.log, checkpoints.jsonl, metrics.json).
+
+Lifecycle is a filesystem + HTTP handshake (the worker side is
+documented in worker.py):
+
+- ``start()`` writes each node's spec, spawns the workers with stdout
+  and stderr redirected to the node's ``worker.log``, collects every
+  ``address.json``, optionally interposes a ``PartitionProxy`` on each
+  directed edge, publishes ``peers.json``, and polls ``/healthz`` until
+  every node reports ``ready: true``.
+- ``kill(node, graceful=False)`` is SIGKILL — the real crash the
+  in-process chaos driver can only approximate; ``graceful=True`` is
+  SIGTERM + drain.  ``restart(node)`` respawns from the node's on-disk
+  WAL/reqstore on the *same* transport port, so peer address books and
+  proxy upstreams survive the reboot.
+- ``teardown()`` SIGTERMs everything, escalates to SIGKILL after a
+  grace period, closes proxies, and removes the scratch root.
+
+Client traffic enters through ``submit()``: a dedicated client-side
+``TcpTransport`` dials every node directly (client frames bypass the
+partition proxies — a partitioned node is cut off from its *peers*, not
+from its clients) and ships bare ``pb.Request`` frames that the worker's
+transport hands to ``Node.propose``.
+
+``poll_commits()`` tails every node's fsynced ``app.log`` incrementally
+and returns newly observed commits ``(node, client_id, req_no, seq,
+ts_ns)`` — the ground truth the load generator and the mp chaos driver
+both audit.
+
+This module is the reason lint rule W11 exists: ``subprocess`` (and
+``multiprocessing``) are confined to ``mirbft_tpu/cluster/`` so no other
+package grows an accidental dependency on process spawning.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+from .. import pb
+from ..chaos.live import PartitionProxy
+from ..runtime.transport import TcpTransport
+from .profiles import WAN_PROFILES, profile_latency
+from .worker import read_json, write_json_atomic
+
+# The client-side transport's endpoint id: far outside any node id range
+# (workers discard it — propose frames carry no peer identity).
+_CLIENT_NODE_ID = 1 << 20
+
+
+class WorkerDied(RuntimeError):
+    """A worker process exited while the supervisor still needed it."""
+
+
+class _NodeHandle:
+    """Supervisor-side state for one worker process."""
+
+    def __init__(self, node_id: int, directory: str):
+        self.node_id = node_id
+        self.dir = directory
+        self.spec_path = os.path.join(directory, "spec.json")
+        self.process: subprocess.Popen | None = None
+        self.log_file = None
+        self.transport_port = 0
+        self.metrics_port = 0
+        # app.log tail state (poll_commits)
+        self.log_offset = 0
+        self.log_remainder = b""
+        self.commits: list = []  # [(client_id, req_no, seq)]
+        self.chain = ""
+
+    @property
+    def alive(self) -> bool:
+        return self.process is not None and self.process.poll() is None
+
+    def log_tail(self, max_bytes: int = 4096) -> str:
+        try:
+            with open(os.path.join(self.dir, "worker.log"), "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - max_bytes))
+                return fh.read().decode("utf-8", "replace")
+        except OSError:
+            return "<no worker.log>"
+
+
+class ClusterSupervisor:
+    """Boot and manage a multi-process mirbft-tpu cluster."""
+
+    def __init__(
+        self,
+        node_count: int = 4,
+        client_ids=None,
+        *,
+        root: str | None = None,
+        batch_size: int = 1,
+        processor: str = "serial",
+        profile: str = "lan",
+        latency: dict | None = None,
+        latency_seed: int = 0,
+        tick_seconds: float = 0.04,
+        proxied: bool = False,
+        keep_root: bool = False,
+    ):
+        if profile not in WAN_PROFILES:
+            raise ValueError(
+                f"unknown WAN profile {profile!r}; choose from "
+                f"{sorted(WAN_PROFILES)}"
+            )
+        self.node_count = node_count
+        self.client_ids = list(client_ids) if client_ids else [1, 2]
+        self.batch_size = batch_size
+        self.processor = processor
+        self.profile = profile
+        # Explicit per-link map wins over the named profile.
+        self.latency = (
+            latency
+            if latency is not None
+            else profile_latency(profile, node_count)
+        )
+        self.latency_seed = latency_seed
+        self.tick_seconds = tick_seconds
+        self.proxied = proxied
+        self.keep_root = keep_root
+        self._own_root = root is None
+        self.root = (
+            root
+            if root is not None
+            else tempfile.mkdtemp(prefix="mirbft-cluster-")
+        )
+        self.nodes = [
+            _NodeHandle(n, os.path.join(self.root, f"node{n}"))
+            for n in range(node_count)
+        ]
+        self.proxies: dict = {}  # (src, dst) -> PartitionProxy
+        self._client_transport: TcpTransport | None = None
+        self._started = False
+
+    # -- boot ----------------------------------------------------------------
+
+    def _spec(self, node_id: int, fresh: bool, transport_port: int) -> dict:
+        latency = {
+            str(peer): link
+            for peer, link in self.latency.items()
+            if int(peer) != node_id
+        }
+        return {
+            "node_id": node_id,
+            "node_count": self.node_count,
+            "client_ids": self.client_ids,
+            "dir": self.nodes[node_id].dir,
+            "root": self.root,
+            "batch_size": self.batch_size,
+            "processor": self.processor,
+            "tick_seconds": self.tick_seconds,
+            "transport_port": transport_port,
+            "fresh": fresh,
+            "latency": latency,
+            "latency_seed": self.latency_seed,
+        }
+
+    def _spawn(self, handle: _NodeHandle) -> None:
+        # A stale address.json would satisfy the boot wait instantly;
+        # the handshake must observe *this* incarnation's ports.
+        try:
+            os.remove(os.path.join(handle.dir, "address.json"))
+        except OSError:
+            pass
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # The workers must import this very package even when it is run
+        # from a source tree rather than installed (the worker's cwd is
+        # the scratch root, not the repo).
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        existing = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + existing if existing else pkg_root
+        )
+        handle.log_file = open(
+            os.path.join(handle.dir, "worker.log"), "ab"
+        )
+        handle.process = subprocess.Popen(
+            [sys.executable, "-m", "mirbft_tpu.cluster", "--spec", handle.spec_path],
+            stdout=handle.log_file,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=self.root,
+        )
+
+    def _wait_address(self, handle: _NodeHandle, deadline: float) -> None:
+        path = os.path.join(handle.dir, "address.json")
+        while True:
+            doc = read_json(path)
+            if doc is not None:
+                handle.transport_port = int(doc["transport_port"])
+                handle.metrics_port = int(doc["metrics_port"])
+                return
+            if not handle.alive:
+                raise WorkerDied(
+                    f"node {handle.node_id} exited during boot "
+                    f"(rc={handle.process.returncode}):\n{handle.log_tail()}"
+                )
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"node {handle.node_id} never wrote address.json:\n"
+                    f"{handle.log_tail()}"
+                )
+            time.sleep(0.02)
+
+    def healthz(self, node_id: int) -> dict | None:
+        """One /healthz probe; None when the endpoint is unreachable."""
+        port = self.nodes[node_id].metrics_port
+        if not port:
+            return None
+        url = f"http://127.0.0.1:{port}/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=1.0) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def _wait_ready(self, handle: _NodeHandle, deadline: float) -> None:
+        while True:
+            doc = self.healthz(handle.node_id)
+            if doc is not None and doc.get("ready"):
+                return
+            if not handle.alive:
+                raise WorkerDied(
+                    f"node {handle.node_id} exited before ready "
+                    f"(rc={handle.process.returncode}):\n{handle.log_tail()}"
+                )
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"node {handle.node_id} never reported ready:\n"
+                    f"{handle.log_tail()}"
+                )
+            time.sleep(0.05)
+
+    def _peer_address(self, src: int, dst: int) -> tuple:
+        if self.proxied:
+            return self.proxies[(src, dst)].address
+        return ("127.0.0.1", self.nodes[dst].transport_port)
+
+    def _publish_peers(self, node_id: int) -> None:
+        peers = {
+            str(peer): list(self._peer_address(node_id, peer))
+            for peer in range(self.node_count)
+            if peer != node_id
+        }
+        write_json_atomic(
+            os.path.join(self.nodes[node_id].dir, "peers.json"),
+            {"peers": peers},
+        )
+
+    def start(self, timeout_s: float = 120.0) -> None:
+        """Boot the full cluster and block until every node is ready."""
+        if self._started:
+            raise RuntimeError("cluster already started")
+        self._started = True
+        deadline = time.monotonic() + timeout_s
+        for handle in self.nodes:
+            os.makedirs(handle.dir, exist_ok=True)
+            write_json_atomic(
+                handle.spec_path,
+                self._spec(handle.node_id, fresh=True, transport_port=0),
+            )
+            self._spawn(handle)
+        for handle in self.nodes:
+            self._wait_address(handle, deadline)
+        if self.proxied:
+            for a in range(self.node_count):
+                for b in range(self.node_count):
+                    if a != b:
+                        self.proxies[(a, b)] = PartitionProxy(
+                            ("127.0.0.1", self.nodes[b].transport_port)
+                        )
+        for handle in self.nodes:
+            self._publish_peers(handle.node_id)
+        for handle in self.nodes:
+            self._wait_ready(handle, deadline)
+        self._client_transport = TcpTransport(
+            _CLIENT_NODE_ID,
+            port=0,
+            backoff_base=0.02,
+            backoff_cap=0.25,
+            dial_timeout=1.0,
+        )
+        for handle in self.nodes:
+            self._client_transport.connect(
+                handle.node_id, ("127.0.0.1", handle.transport_port)
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def kill(self, node_id: int, graceful: bool = False, timeout_s: float = 15.0) -> None:
+        """Stop one node: SIGTERM + drain when graceful, SIGKILL when not
+        (the chaos crash path — nothing un-fsynced survives)."""
+        handle = self.nodes[node_id]
+        if handle.process is None:
+            return
+        if handle.alive:
+            if graceful:
+                handle.process.send_signal(signal.SIGTERM)
+                try:
+                    handle.process.wait(timeout=timeout_s)
+                except subprocess.TimeoutExpired:
+                    handle.process.kill()
+                    handle.process.wait(timeout=timeout_s)
+            else:
+                handle.process.kill()
+                handle.process.wait(timeout=timeout_s)
+        if handle.log_file is not None:
+            handle.log_file.close()
+            handle.log_file = None
+        handle.process = None
+
+    def restart(self, node_id: int, timeout_s: float = 60.0) -> None:
+        """Respawn a killed node from its on-disk state, on its original
+        transport port."""
+        handle = self.nodes[node_id]
+        if handle.alive:
+            raise RuntimeError(f"node {node_id} is still running")
+        write_json_atomic(
+            handle.spec_path,
+            self._spec(
+                node_id, fresh=False, transport_port=handle.transport_port
+            ),
+        )
+        deadline = time.monotonic() + timeout_s
+        self._spawn(handle)
+        self._wait_address(handle, deadline)
+        self._wait_ready(handle, deadline)
+
+    def alive_nodes(self) -> list:
+        return [h.node_id for h in self.nodes if h.alive]
+
+    @property
+    def node_ids(self) -> list:
+        """The load generator's duck interface (see loadgen.generator)."""
+        return [h.node_id for h in self.nodes]
+
+    # -- partitions ----------------------------------------------------------
+
+    def set_partition(self, groups, cut: bool) -> None:
+        """Cut (or heal) every proxied edge crossing the group boundary;
+        requires ``proxied=True`` at construction."""
+        if not self.proxied:
+            raise RuntimeError(
+                "set_partition requires ClusterSupervisor(proxied=True)"
+            )
+        group_of = {}
+        for gi, group in enumerate(groups):
+            for node in group:
+                group_of[node] = gi
+        for a in range(self.node_count):
+            for b in range(self.node_count):
+                if a != b and group_of.get(a) != group_of.get(b):
+                    self.proxies[(a, b)].set_cut(cut)
+
+    # -- client traffic ------------------------------------------------------
+
+    def submit(self, node_id: int, request: pb.Request) -> None:
+        """Ship one client request to one node (fire-and-forget; the
+        transport's reconnect backoff absorbs a down target)."""
+        if self._client_transport is None:
+            raise RuntimeError("cluster not started")
+        self._client_transport.propose(node_id, request)
+
+    # -- commit observation --------------------------------------------------
+
+    def poll_commits(self) -> list:
+        """Incrementally tail every node's app.log; returns newly seen
+        commits as ``(node_id, client_id, req_no, seq, ts_ns)``.  Torn or
+        garbled lines (crash tails) are skipped, not fatal."""
+        out = []
+        for handle in self.nodes:
+            path = os.path.join(handle.dir, "app.log")
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(handle.log_offset)
+                    chunk = fh.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            handle.log_offset += len(chunk)
+            data = handle.log_remainder + chunk
+            lines = data.split(b"\n")
+            handle.log_remainder = lines.pop()
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                handle.chain = rec.get("chain", handle.chain)
+                if rec.get("t") != "apply":
+                    continue
+                ts_ns = rec.get("ts_ns")
+                for client_id, req_no, _digest in rec["reqs"]:
+                    handle.commits.append((client_id, req_no, rec["seq"]))
+                    out.append(
+                        (handle.node_id, client_id, req_no, rec["seq"], ts_ns)
+                    )
+        return out
+
+    def committed(self, node_id: int) -> list:
+        """Every commit observed so far on one node (tail first)."""
+        self.poll_commits()
+        return list(self.nodes[node_id].commits)
+
+    def chains(self) -> list:
+        """Last observed app-chain hex digest per node (tail first)."""
+        self.poll_commits()
+        return [h.chain for h in self.nodes]
+
+    # -- teardown ------------------------------------------------------------
+
+    def teardown(self) -> None:
+        """Stop everything; idempotent."""
+        if self._client_transport is not None:
+            self._client_transport.close(0)
+            self._client_transport = None
+        for handle in self.nodes:
+            if handle.alive:
+                handle.process.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 15.0
+        for handle in self.nodes:
+            if handle.process is None:
+                continue
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                handle.process.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                handle.process.kill()
+                handle.process.wait(timeout=10.0)
+            if handle.log_file is not None:
+                handle.log_file.close()
+                handle.log_file = None
+            handle.process = None
+        for proxy in self.proxies.values():
+            proxy.close()
+        self.proxies = {}
+        if self._own_root and not self.keep_root:
+            shutil.rmtree(self.root, ignore_errors=True)
+
+    def __enter__(self) -> "ClusterSupervisor":
+        self.start()
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.teardown()

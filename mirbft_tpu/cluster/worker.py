@@ -1,0 +1,340 @@
+"""The per-node worker process: one OS process per consensus node.
+
+``python -m mirbft_tpu.cluster --spec <node_dir>/spec.json`` runs one
+node end to end: storage under the node directory, a serializer-owned
+protocol core (``runtime.Node``), a ``TcpTransport`` mesh link, and the
+standard consumer loop driving the selected processor.  The supervisor
+(supervisor.py) owns process lifecycle; this module owns everything that
+happens inside one process.
+
+Boot is a two-phase handshake over the shared filesystem (every process
+runs on one host — the multi-*process* cluster is about real OS-level
+isolation, kill -9 fidelity, and true parallelism, not distribution):
+
+1. The worker binds its transport + metrics ports, then atomically
+   writes ``address.json`` (tmp + rename) with its pid and bound ports.
+   ``/healthz`` reports ``ready: false`` during this window.
+2. The supervisor collects every node's ``address.json``, builds the
+   (optionally proxied) peer address map, and writes ``peers.json`` into
+   each node directory.  The worker polls for that file, dials every
+   peer, applies the spec's per-link latency profile, and only then
+   flips ``/healthz`` to ``ready: true`` — so one HTTP poll tells the
+   supervisor the true mesh is wired.
+
+State transfer is filesystem-mediated: each worker appends every
+checkpoint it computes to ``checkpoints.jsonl`` in its node directory,
+and a worker that falls behind scans its peers' checkpoint files for the
+target (the cross-process analogue of ``LiveReplica._serve_transfer``).
+Checkpoint records are soft state — rebuilt from consensus on restart —
+so they are flushed but not fsynced (durability fsyncs stay in
+storage.py and chaos/live.py, per lint rule W10).
+
+On SIGTERM the worker drains the processor, closes storage cleanly, and
+dumps a final ``metrics.json`` registry snapshot; SIGKILL (the chaos
+crash path) gets none of that, which is exactly the point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+from .. import pb
+from ..chaos.live import DurableChainLog
+from ..obsv import hooks
+from ..obsv.metrics import Registry
+from ..runtime import (
+    Config,
+    FileRequestStore,
+    FileWal,
+    Node,
+    build_processor,
+)
+from ..runtime.node import NodeStopped, standard_initial_network_state
+from ..runtime.transport import TcpTransport
+
+# How long the worker waits for the supervisor's peers.json before
+# concluding it was orphaned.
+_PEERS_TIMEOUT_S = 60.0
+
+# Fixed-port rebinds retry through TIME_WAIT for this long (restart path).
+_BIND_RETRY_S = 10.0
+
+
+def write_json_atomic(path: str, payload: dict) -> None:
+    """Write ``payload`` via tmp + rename so readers never see a torn
+    file — the handshake files (address.json, peers.json) are polled by
+    another process."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
+def read_json(path: str) -> dict | None:
+    """Best-effort read of a handshake file; None while absent."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+class Worker:
+    """One consensus node inside its own OS process."""
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.node_id = int(spec["node_id"])
+        self.dir = spec["dir"]
+        self.root = spec["root"]
+        self.tick_seconds = float(spec.get("tick_seconds", 0.04))
+        self._stop = threading.Event()
+        os.makedirs(self.dir, exist_ok=True)
+
+        hooks.enable(registry=Registry(), trace=False)
+        self.app_log = DurableChainLog(
+            os.path.join(self.dir, "app.log"), self.node_id, timestamps=True
+        )
+        self.wal = FileWal(os.path.join(self.dir, "wal"))
+        self.reqstore = FileRequestStore(os.path.join(self.dir, "reqs"))
+        config = Config(
+            id=self.node_id,
+            batch_size=int(spec.get("batch_size", 1)),
+            processor=spec.get("processor", "serial"),
+            metrics_port=0,
+        )
+        if spec.get("fresh", True):
+            state = standard_initial_network_state(
+                int(spec["node_count"]), list(spec["client_ids"])
+            )
+            self.node = Node.start_new(config, state)
+        else:
+            self.node = Node.restart(config, self.wal, self.reqstore)
+        # Not ready until the peer mesh is dialed (phase 2 below).
+        self.node.set_ready(False)
+        self.transport = self._bind(int(spec.get("transport_port", 0)))
+        self._checkpoint_file = open(
+            os.path.join(self.dir, "checkpoints.jsonl"), "a", encoding="utf-8"
+        )
+        self._announced: set = set()
+
+    # -- boot handshake ------------------------------------------------------
+
+    def _bind(self, port: int) -> TcpTransport:
+        """Bind the transport; restarts re-bind the recorded port
+        (retrying through TIME_WAIT) so peers' registered addresses and
+        the supervisor's proxies stay valid across the reboot."""
+        deadline = time.monotonic() + _BIND_RETRY_S
+        while True:
+            try:
+                return TcpTransport(
+                    self.node_id,
+                    port=port,
+                    backoff_base=0.02,
+                    backoff_cap=0.25,
+                    dial_timeout=1.0,
+                )
+            except OSError:
+                if port == 0 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.02)
+
+    def announce(self) -> None:
+        write_json_atomic(
+            os.path.join(self.dir, "address.json"),
+            {
+                "pid": os.getpid(),
+                "transport_port": self.transport.address[1],
+                "metrics_port": self.node.metrics_address[1],
+            },
+        )
+
+    def wire(self) -> None:
+        """Phase 2: wait for peers.json, dial the mesh, apply the link
+        latency profile, go ready."""
+        peers_path = os.path.join(self.dir, "peers.json")
+        deadline = time.monotonic() + _PEERS_TIMEOUT_S
+        while True:
+            peers_doc = read_json(peers_path)
+            if peers_doc is not None:
+                break
+            if self._stop.is_set():
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"node {self.node_id}: no peers.json after "
+                    f"{_PEERS_TIMEOUT_S:.0f}s (supervisor gone?)"
+                )
+            time.sleep(0.02)
+        self.transport.serve(self.node)
+        latency = self.spec.get("latency", {})
+        seed = int(self.spec.get("latency_seed", 0))
+        for peer_str, address in peers_doc["peers"].items():
+            peer_id = int(peer_str)
+            link = latency.get(peer_str) or latency.get(str(peer_id))
+            if link:
+                # Before connect(): the per-peer channel picks its
+                # LinkLatency up at creation, so no frame ever bypasses
+                # the emulated delay.
+                self.transport.set_link_latency(
+                    peer_id,
+                    float(link.get("delay_ms", 0.0)) / 1000.0,
+                    jitter_s=float(link.get("jitter_ms", 0.0)) / 1000.0,
+                    seed=seed,
+                )
+            self.transport.connect(peer_id, tuple(address))
+        self.processor = build_processor(
+            self.node,
+            self.transport.link(),
+            self.app_log,
+            self.wal,
+            self.reqstore,
+        )
+        if hasattr(self.processor, "on_results"):
+            self.processor.on_results = self._capture_checkpoints
+        self.node.set_ready(True)
+
+    # -- checkpoints / state transfer ---------------------------------------
+
+    def _capture_checkpoints(self, results) -> None:
+        for cr in results.checkpoints:
+            seq_no = cr.checkpoint.seq_no
+            if seq_no in self._announced:
+                continue
+            self._announced.add(seq_no)
+            state = pb.NetworkState(
+                config=cr.checkpoint.network_config,
+                clients=cr.checkpoint.clients_state,
+                pending_reconfigurations=list(cr.reconfigurations),
+            )
+            self._checkpoint_file.write(
+                json.dumps(
+                    {
+                        "seq": seq_no,
+                        "value": cr.value.hex(),
+                        "state": pb.encode(state).hex(),
+                    }
+                )
+                + "\n"
+            )
+            self._checkpoint_file.flush()
+
+    def _serve_transfer(self, target) -> None:
+        """Fill a state-transfer request from a peer's published
+        checkpoint file; fail it (the node re-requests later) when no
+        peer has announced the target yet."""
+        want_value = target.value.hex()
+        for peer in range(int(self.spec["node_count"])):
+            if peer == self.node_id:
+                continue
+            path = os.path.join(self.root, f"node{peer}", "checkpoints.jsonl")
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    lines = fh.readlines()
+            except OSError:
+                continue
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail of a concurrently-written file
+                if rec["seq"] == target.seq_no and rec["value"] == want_value:
+                    network_state = pb.decode(
+                        pb.NetworkState, bytes.fromhex(rec["state"])
+                    )
+                    self.app_log.adopt(target.value, target.seq_no)
+                    self.node.state_transfer_complete(target, network_state)
+                    return
+        self.node.state_transfer_failed(target)
+
+    # -- the consumer loop ---------------------------------------------------
+
+    def run(self) -> int:
+        """Drive the node until SIGTERM (or serializer death); returns
+        the process exit code."""
+        last_tick = time.monotonic()
+        code = 0
+        try:
+            while not self._stop.is_set():
+                actions = self.node.ready(timeout=0.01)
+                if actions is not None:
+                    results = self.processor.process(actions)
+                    self._capture_checkpoints(results)
+                    if results.digests or results.checkpoints:
+                        self.node.add_results(results)
+                now = time.monotonic()
+                if now - last_tick >= self.tick_seconds:
+                    last_tick = now
+                    self.node.tick()
+                if actions is not None and actions.state_transfer is not None:
+                    self._serve_transfer(actions.state_transfer)
+        except NodeStopped:
+            pass
+        except Exception as err:  # noqa: BLE001 — report, then die nonzero
+            print(f"node {self.node_id} consumer died: {err!r}", file=sys.stderr)
+            code = 3
+        if self.node.exit_error is not None:
+            print(
+                f"node {self.node_id} serializer died: "
+                f"{self.node.exit_error!r}",
+                file=sys.stderr,
+            )
+            code = 4
+        self._shutdown(graceful=code == 0)
+        return code
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _shutdown(self, graceful: bool) -> None:
+        closer = getattr(self.processor, "close", None)
+        if closer is not None:
+            try:
+                closer()  # drain in-flight batches before storage closes
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                pass
+        self.transport.close(0.5)
+        self.node.stop()
+        self._checkpoint_file.close()
+        if graceful:
+            self.wal.close()
+            self.reqstore.close()
+            self.app_log.close()
+            snapshot = hooks.metrics.snapshot() if hooks.enabled else {}
+            write_json_atomic(
+                os.path.join(self.dir, "metrics.json"), snapshot
+            )
+        else:
+            self.wal.crash()
+            self.reqstore.crash()
+            self.app_log.crash()
+        hooks.disable()
+
+
+def run_worker(spec_path: str) -> int:
+    """Entry point for ``python -m mirbft_tpu.cluster --spec <path>``."""
+    with open(spec_path, "r", encoding="utf-8") as fh:
+        spec = json.load(fh)
+    worker = Worker(spec)
+
+    def _on_term(_signum, _frame):
+        worker.stop()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    worker.announce()
+    try:
+        worker.wire()
+    except Exception as err:  # noqa: BLE001 — boot failure must exit nonzero
+        print(f"node {worker.node_id} wiring failed: {err!r}", file=sys.stderr)
+        worker._shutdown(graceful=False)
+        return 2
+    return worker.run()

@@ -37,6 +37,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from .. import pb
+from ..obsv import hooks
 from .actions import Actions
 from .msgbuffers import Applyable, MsgBuffer, NodeBuffers
 from .persisted import Persisted
@@ -1582,6 +1583,7 @@ class ClientTracker:
         clients_get = self.clients.get
         available_push = self.available_list.push_back
         bit = 1 << source
+        fast = self._fast
         for msg in msgs:
             ack = msg.type
             client = clients_get(ack.client_id)
@@ -1639,6 +1641,12 @@ class ClientTracker:
             client._tick_pending.add(req_no)
             if req_no == client.next_ready_mark and crn.strong_requests:
                 self.check_ready(client, crn)
+            if fast is not None:
+                # A live mirror (left over from large-frame deliveries) must
+                # see every small-frame mutation too, or its tick_class goes
+                # stale vs the python tick path (step_ack keeps the same
+                # invariant one ack at a time).
+                fast.refresh(ack.client_id, req_no)
 
     def step(self, source: int, msg: pb.Msg) -> Actions:
         verdict = self.filter(source, msg)
@@ -1675,9 +1683,24 @@ class ClientTracker:
             return out if out is not None else Actions()
         if not client.in_watermarks(ack.req_no):
             # Already committed / out of window.
+            if hooks.enabled and ack.req_no < client.low_watermark:
+                # Retry-storm dedup: the window already retired this
+                # req_no, so the resubmission is absorbed without effect.
+                hooks.metrics.counter(
+                    "mirbft_request_duplicates_total", reason="retired"
+                ).inc()
             return out if out is not None else Actions()
         client._tick_pending.add(ack.req_no)
         crn = client.req_no(ack.req_no)
+        if hooks.enabled:
+            if crn.committed is not None:
+                hooks.metrics.counter(
+                    "mirbft_request_duplicates_total", reason="committed"
+                ).inc()
+            elif ack.digest in crn.my_requests:
+                hooks.metrics.counter(
+                    "mirbft_request_duplicates_total", reason="stored"
+                ).inc()
         had_my = len(crn.my_requests)
         actions = crn.apply_request_digest(ack, data, out)
         if self._fast is not None:
@@ -1724,6 +1747,22 @@ class ClientTracker:
         if req.agreements & (1 << self.my_config.id):
             return Actions()  # we already hold + acked it
         req.agreements |= 1 << source
+        # Same quorum bookkeeping as apply_request_ack: this out-of-band
+        # agreement bump can cross the weak/strong thresholds, and the
+        # vector path only promotes on *exact* crossings it applies itself —
+        # a skipped crossing here would never be retried (refresh re-derives
+        # the canonical/tick view, not quorum membership).
+        key = msg.request_ack.digest or _NULL
+        count = req.agreements.bit_count()
+        if count >= crn._weak_quorum:
+            was_weak = key in crn.weak_requests
+            crn.weak_requests[key] = req
+            if count >= crn._strong_quorum:
+                crn.strong_requests[key] = req
+            if not was_weak:
+                self.available_list.push_back(req)
+            client._tick_pending.add(msg.request_ack.req_no)
+            self.check_ready(client, crn)
         if self._fast is not None:
             self._fast.refresh(
                 msg.request_ack.client_id, msg.request_ack.req_no

@@ -1,0 +1,69 @@
+"""Client behaviour models for the load generator.
+
+An arrival process says *when* a request enters; a ``ClientModel`` says
+*how*: payload sizing (fixed or mixed), added submit lag (a slow client
+whose requests reach the cluster late), and the retry-storm policy — a
+client that re-submits a request it believes timed out, fanned out to
+several nodes at once.  Retries are the hostile case request dedup
+exists for (PAPER.md's duplicate-suppression claim); the generator
+counts them separately so goodput never double-counts a retried commit.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ClientModel:
+    """How one client misbehaves (or doesn't)."""
+
+    # Fixed payload size, or choose-per-request from payload_choices.
+    payload_bytes: int = 32
+    payload_choices: tuple = ()  # e.g. (16, 256, 4096) for mixed sizes
+    # A slow client: its requests arrive this long after their planned
+    # open-loop instant.
+    submit_lag_s: float = 0.0
+    # Retry storm: when a request is uncommitted for retry_timeout_s,
+    # re-submit it to retry_fanout distinct nodes (round-robin over the
+    # cluster); None disables retries.
+    retry_timeout_s: float | None = None
+    retry_fanout: int = 1
+
+    def __post_init__(self):
+        if self.payload_bytes <= 0:
+            raise ValueError("payload_bytes must be positive")
+        if self.submit_lag_s < 0:
+            raise ValueError("submit_lag_s must be >= 0")
+        if self.retry_timeout_s is not None and self.retry_timeout_s <= 0:
+            raise ValueError("retry_timeout_s must be positive")
+        if self.retry_fanout < 1:
+            raise ValueError("retry_fanout must be >= 1")
+
+    def payload(self, rng: random.Random, req_no: int) -> bytes:
+        size = (
+            rng.choice(self.payload_choices)
+            if self.payload_choices
+            else self.payload_bytes
+        )
+        # Stamp the req_no, pad deterministically: payloads differ per
+        # request but replays of the same (client, req_no) are identical,
+        # which dedup requires.
+        stamp = b"%d:" % req_no
+        return (stamp + b"x" * size)[: max(size, len(stamp))]
+
+
+# The mix exercised by the bench rung: one honest client, one slow
+# client with mixed payload sizes, one retry-stormer.
+def standard_client_models(client_ids) -> dict:
+    """Assign models round-robin over ``(honest, slow+mixed, stormy)``."""
+    models = (
+        ClientModel(),
+        ClientModel(payload_choices=(16, 256, 1024), submit_lag_s=0.05),
+        ClientModel(retry_timeout_s=1.0, retry_fanout=2),
+    )
+    return {
+        client_id: models[i % len(models)]
+        for i, client_id in enumerate(client_ids)
+    }

@@ -1,0 +1,315 @@
+"""An in-process cluster backend for the load generator.
+
+``InProcessCluster`` satisfies the generator's duck interface with N
+real runtime ``Node``s in one process: direct-call links (no sockets),
+in-memory WAL/request-store stubs honouring the storage contract, and a
+hash-chain app log that stamps each commit with ``time.monotonic_ns()``.
+It exists so the tier-1 loadgen smoke test exercises the full
+submit→consensus→commit→latency pipeline in a couple of seconds,
+without process spawns or fsyncs; the multi-process path through
+``ClusterSupervisor`` is covered by the slow-marked cluster tests and
+the bench ``live_mp_*`` rung.
+
+The consumer loop per node is the standard runtime embedding (see
+``chaos.live.LiveReplica._consume``): ready → process → add_results,
+with wall-clock ticks and in-memory checkpoint serving for state
+transfer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+from .. import pb
+from ..runtime import Config, Node, build_processor
+from ..runtime.node import NodeStopped, standard_initial_network_state
+from ..runtime.processor import Link, Log
+
+
+class MemWal:
+    """The WAL storage contract, in memory (sync points are no-ops)."""
+
+    def __init__(self):
+        self.entries: dict = {}  # index -> encoded entry
+        self.fault_hook = None
+
+    def write(self, index: int, entry) -> None:
+        self.entries[index] = entry
+
+    def truncate(self, index: int) -> None:
+        for stale in [i for i in self.entries if i < index]:
+            del self.entries[stale]
+
+    def sync(self) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook()
+
+    def sync_token(self) -> int:
+        return 0
+
+    def wait(self, token: int) -> None:
+        pass
+
+    def load_all(self, for_each) -> None:
+        for index in sorted(self.entries):
+            for_each(index, self.entries[index])
+
+    def close(self) -> None:
+        pass
+
+    crash = close
+
+
+class MemRequestStore:
+    """The request-store contract, in memory."""
+
+    def __init__(self):
+        self.data: dict = {}  # (client_id, req_no, digest) -> payload
+        self.committed: set = set()
+        self.fault_hook = None
+
+    @staticmethod
+    def _key(ack) -> tuple:
+        return (ack.client_id, ack.req_no, bytes(ack.digest))
+
+    def store(self, ack, data: bytes) -> None:
+        self.data[self._key(ack)] = data
+
+    def get(self, ack):
+        return self.data.get(self._key(ack))
+
+    def commit(self, ack) -> None:
+        self.committed.add(self._key(ack))
+
+    def sync(self) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook()
+
+    def sync_token(self) -> int:
+        return 0
+
+    def wait(self, token: int) -> None:
+        pass
+
+    def uncommitted(self, for_each) -> None:
+        for key, data in self.data.items():
+            if key not in self.committed:
+                client_id, req_no, digest = key
+                for_each(
+                    pb.RequestAck(
+                        client_id=client_id, req_no=req_no, digest=digest
+                    ),
+                    data,
+                )
+
+    def close(self) -> None:
+        pass
+
+    crash = close
+
+
+class MemChainLog(Log):
+    """Hash-chain application state with monotonic commit stamps."""
+
+    def __init__(self, node_id: int, sink):
+        self.node_id = node_id
+        self.sink = sink  # callable(node_id, client_id, req_no, seq, ts_ns)
+        self.chain = b""
+        self.commits: list = []  # [(client_id, req_no, seq)]
+        self.last_seq = 0
+
+    def apply(self, q_entry: pb.QEntry) -> None:
+        if q_entry.seq_no <= self.last_seq:
+            return
+        ts_ns = time.monotonic_ns()
+        for ack in q_entry.requests:
+            h = hashlib.sha256()
+            h.update(self.chain)
+            h.update(ack.digest)
+            self.chain = h.digest()
+            self.commits.append((ack.client_id, ack.req_no, q_entry.seq_no))
+            self.sink(
+                self.node_id, ack.client_id, ack.req_no, q_entry.seq_no, ts_ns
+            )
+        self.last_seq = q_entry.seq_no
+
+    def adopt(self, value: bytes, seq_no: int) -> None:
+        self.chain = value
+        if seq_no > self.last_seq:
+            self.last_seq = seq_no
+
+    def snap(self, network_config, clients_state) -> bytes:
+        return self.chain
+
+
+class _DirectLink(Link):
+    """Same-process message passing: send == dest.step(source, msg)."""
+
+    def __init__(self, cluster, source: int):
+        self.cluster = cluster
+        self.source = source
+
+    def send(self, dest: int, msg: pb.Msg) -> None:
+        replica = self.cluster.replicas[dest]
+        if replica is None:
+            return
+        try:
+            replica.node.step(self.source, msg)
+        except (NodeStopped, ValueError):
+            pass
+
+
+class _InProcReplica:
+    def __init__(self, cluster, node_id: int, initial_state, processor: str):
+        self.cluster = cluster
+        self.node_id = node_id
+        self.app_log = MemChainLog(node_id, cluster._on_commit)
+        self.wal = MemWal()
+        self.reqstore = MemRequestStore()
+        config = Config(
+            id=node_id,
+            batch_size=cluster.batch_size,
+            processor=processor,
+        )
+        self.node = Node.start_new(config, initial_state)
+        self.processor = build_processor(
+            self.node,
+            _DirectLink(cluster, node_id),
+            self.app_log,
+            self.wal,
+            self.reqstore,
+        )
+        self.checkpoints: dict = {}
+        if hasattr(self.processor, "on_results"):
+            self.processor.on_results = self._capture_checkpoints
+        self.failed = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._consume,
+            name=f"loadgen-consumer-{node_id}",
+            daemon=True,
+        )
+
+    def _capture_checkpoints(self, results) -> None:
+        for cr in results.checkpoints:
+            self.checkpoints[cr.checkpoint.seq_no] = (
+                cr.value,
+                pb.NetworkState(
+                    config=cr.checkpoint.network_config,
+                    clients=cr.checkpoint.clients_state,
+                    pending_reconfigurations=list(cr.reconfigurations),
+                ),
+            )
+
+    def _consume(self) -> None:
+        tick_seconds = self.cluster.tick_seconds
+        last_tick = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                actions = self.node.ready(timeout=0.01)
+                if actions is not None:
+                    results = self.processor.process(actions)
+                    self._capture_checkpoints(results)
+                    if results.digests or results.checkpoints:
+                        self.node.add_results(results)
+                now = time.monotonic()
+                if now - last_tick >= tick_seconds:
+                    last_tick = now
+                    self.node.tick()
+                if actions is not None and actions.state_transfer is not None:
+                    self._serve_transfer(actions.state_transfer)
+        except NodeStopped:
+            pass
+        except Exception as err:  # noqa: BLE001 — surfaced via cluster.check()
+            self.failed = err
+
+    def _serve_transfer(self, target) -> None:
+        for peer in self.cluster.replicas:
+            if peer is None or peer is self:
+                continue
+            entry = peer.checkpoints.get(target.seq_no)
+            if entry is None or entry[0] != target.value:
+                continue
+            value, network_state = entry
+            self.app_log.adopt(value, target.seq_no)
+            self.node.state_transfer_complete(target, network_state)
+            return
+        self.node.state_transfer_failed(target)
+
+    def stop(self) -> None:
+        self._stop.set()
+        closer = getattr(self.processor, "close", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        if self._thread.ident is not None:
+            self._thread.join(timeout=10)
+        self.node.stop()
+
+
+class InProcessCluster:
+    """N runtime nodes behind the load generator's duck interface."""
+
+    def __init__(
+        self,
+        node_count: int = 4,
+        client_ids=None,
+        *,
+        batch_size: int = 1,
+        processor: str = "serial",
+        tick_seconds: float = 0.02,
+    ):
+        self.batch_size = batch_size
+        self.tick_seconds = tick_seconds
+        self.client_ids = list(client_ids) if client_ids else [1, 2]
+        self._lock = threading.Lock()
+        self._commits: list = []
+        state = standard_initial_network_state(node_count, self.client_ids)
+        self.replicas = [
+            _InProcReplica(self, n, state, processor)
+            for n in range(node_count)
+        ]
+        for replica in self.replicas:
+            replica._thread.start()
+
+    @property
+    def node_ids(self) -> list:
+        return [replica.node_id for replica in self.replicas]
+
+    def _on_commit(self, node_id, client_id, req_no, seq, ts_ns) -> None:
+        with self._lock:
+            self._commits.append((node_id, client_id, req_no, seq, ts_ns))
+
+    def submit(self, node_id: int, request: pb.Request) -> None:
+        try:
+            self.replicas[node_id].node.propose(request)
+        except (NodeStopped, ValueError):
+            pass
+
+    def poll_commits(self) -> list:
+        with self._lock:
+            out = self._commits
+            self._commits = []
+        return out
+
+    def check(self) -> None:
+        """Raise the first consumer/serializer failure, if any."""
+        for replica in self.replicas:
+            if replica.failed is not None:
+                raise replica.failed
+            if replica.node.exit_error is not None:
+                raise replica.node.exit_error
+
+    def close(self) -> None:
+        for replica in self.replicas:
+            replica.stop()
+
+    def __enter__(self) -> "InProcessCluster":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.close()

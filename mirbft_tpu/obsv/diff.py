@@ -13,7 +13,14 @@ Supported artifact shapes (auto-detected):
   ``phase.<name>.{p50,p95,p99}_ms`` plus ``phase.<name>.count``.
 - **bench.py JSON** (``metric``/``stages`` keys): numeric top-level
   fields (rates, p99s, walls), per-stage ``seconds`` from ``stages``,
-  and per-stage engine gauges from ``engine_gauges``.
+  and per-stage engine gauges from ``engine_gauges``.  A nested
+  ``loadgen`` SLO artifact (the live_mp_* rungs embed one) contributes
+  its per-step series too.
+- **loadgen SLO JSON** (``schema: mirbft-loadgen-slo/…``): per
+  arrival-rate step, ``step.<name>.{goodput_per_sec,p50_ms,p95_ms,
+  p99_ms,committed_reqs,…}`` — so a latency-SLO regression between two
+  load runs gates exactly like a timeline regression (``duplicates``
+  and ``timed_out`` are reported as informational).
 
 Direction is inferred per series name: throughput-like series
 (``per_sec``, ``rate``, ``count``, ``events``) regress when they *drop*;
@@ -43,8 +50,34 @@ def direction(name):
     return None
 
 
+def _loadgen_series(doc, prefix=""):
+    """Per-step series from a ``mirbft-loadgen-slo`` artifact.  The
+    ``committed`` count is exposed as ``committed_reqs`` so the
+    direction rules read it as throughput-like; ``duplicates`` and
+    ``timed_out`` match no direction token and stay informational."""
+    series = {}
+    for step in doc.get("steps") or []:
+        base = f"{prefix}step.{step.get('name', 'step')}."
+        for key, out in (
+            ("offered_rate_per_sec", "offered_rate_per_sec"),
+            ("goodput_per_sec", "goodput_per_sec"),
+            ("p50_ms", "p50_ms"),
+            ("p95_ms", "p95_ms"),
+            ("p99_ms", "p99_ms"),
+            ("committed", "committed_reqs"),
+            ("duplicates", "duplicates"),
+            ("timed_out", "timed_out"),
+        ):
+            value = step.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                series[base + out] = float(value)
+    return series
+
+
 def extract_series(artifact):
     """Flatten one parsed artifact into ``{series_name: float}``."""
+    if str(artifact.get("schema", "")).startswith("mirbft-loadgen-slo"):
+        return _loadgen_series(artifact)
     if "traceEvents" in artifact:
         profiler = TimelineProfiler.from_chrome_trace(artifact)
         series = {}
@@ -66,6 +99,9 @@ def extract_series(artifact):
         for gauge, value in (gauges or {}).items():
             if isinstance(value, (int, float)):
                 series[f"engine.{stage}.{gauge}"] = float(value)
+    loadgen_doc = artifact.get("loadgen")
+    if isinstance(loadgen_doc, dict):
+        series.update(_loadgen_series(loadgen_doc, prefix="loadgen."))
     return series
 
 

@@ -44,6 +44,11 @@ class ObsvExporter:
         self._status_fn = status_fn
         self._node_id = node_id
         self._closed = False
+        # Reported by /healthz.  True by default (a node that serves is
+        # live); the cluster runner's worker flips it False before wiring
+        # and True once the transport mesh is connected, so the
+        # supervisor's readiness handshake is one HTTP poll.
+        self.ready = True
         exporter = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -107,7 +112,7 @@ class ObsvExporter:
         return status, "application/json", 200
 
     def _healthz(self):
-        body = {"ok": True}
+        body = {"ok": True, "ready": bool(self.ready)}
         if self._node_id is not None:
             body["node_id"] = self._node_id
         return json.dumps(body), "application/json", 200

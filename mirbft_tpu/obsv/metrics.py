@@ -52,6 +52,7 @@ CATALOG = {
     "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
     "mirbft_proc_stage_queue_depth": "Pipelined processor: batches queued at each stage hand-off.",
     "mirbft_reqstore_appends_total": "Request-store record appends.",
+    "mirbft_request_duplicates_total": "Duplicate client submissions absorbed by request dedup, by reason (retired/committed/stored).",
     "mirbft_reqstore_group_commit_batches": "Request-store sync tickets satisfied by group-commit fsyncs.",
     "mirbft_reqstore_group_sync_wait_seconds": "Per-waiter request-store group-commit latency (ticket issue to durable).",
     "mirbft_seq_milestones_total": "Consensus milestones reached, by milestone name, epoch, and bucket.",
@@ -89,6 +90,7 @@ CATALOG_LABELS = {
     "mirbft_proc_phase_seconds": ("phase",),
     "mirbft_proc_stage_queue_depth": ("stage",),
     "mirbft_reqstore_appends_total": (),
+    "mirbft_request_duplicates_total": ("reason",),
     "mirbft_reqstore_group_commit_batches": (),
     "mirbft_reqstore_group_sync_wait_seconds": (),
     "mirbft_reqstore_fsync_seconds": (),

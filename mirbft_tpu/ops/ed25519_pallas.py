@@ -615,6 +615,8 @@ def launch_rows(rows: list, sublanes: int = 16):
     residual tail length.  Padding rows' results are discarded."""
     from .batching import next_pow2
 
+    if not rows:
+        raise ValueError("launch_rows requires at least one marshalled row")
     tile = sublanes * LANES
     bucket = next_pow2(len(rows), floor=tile)
     padded_rows = rows + [rows[0]] * (bucket - len(rows))

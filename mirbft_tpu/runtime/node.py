@@ -230,6 +230,13 @@ class Node:
         """``(host, port)`` of the HTTP endpoint, or None when disabled."""
         return self._exporter.address if self._exporter is not None else None
 
+    def set_ready(self, ready: bool) -> None:
+        """Flip the /healthz readiness flag (no-op without an exporter).
+        The cluster worker reports not-ready between boot and transport
+        wiring so the supervisor's handshake observes a true mesh."""
+        if self._exporter is not None:
+            self._exporter.ready = ready
+
     # -- HTTP endpoint plumbing (runs on exporter request threads) -----------
 
     def _live_registry(self):

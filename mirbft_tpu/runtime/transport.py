@@ -46,6 +46,7 @@ node can carry.
 from __future__ import annotations
 
 import collections
+import random
 import select
 import socket
 import struct
@@ -85,6 +86,36 @@ def _set_nodelay(conn: socket.socket) -> None:
 # small integers assigned by NetworkConfig; 2**62 keeps the varint within
 # the codec's 64-bit bound while staying unmistakably out of range.
 _HELLO_SRC = 1 << 62
+
+# Reserved frame source id marking a client proposal: the payload after
+# the id is a bare pb.Request (not a pb.Msg), delivered to node.propose.
+# This keeps every socket a client endpoint needs inside this module —
+# loadgen and the cluster supervisor submit through a TcpTransport
+# instead of opening raw sockets of their own (lint rule W9).
+_PROPOSE_SRC = (1 << 62) + 1
+
+
+class LinkLatency:
+    """Emulated one-way link latency: frames to the peer are held on the
+    sender queue until ``delay + U(0, jitter)`` has elapsed since enqueue.
+    Deterministic per (seed, peer): chaos/bench runs with the same seed
+    see the same jitter sequence.  Emulation happens before the real
+    socket write, so it composes with (and adds to) genuine network
+    latency — loopback clusters gain a WAN rung without root or ``tc``."""
+
+    __slots__ = ("delay_s", "jitter_s", "_rng")
+
+    def __init__(self, delay_s: float, jitter_s: float = 0.0, seed: int = 0):
+        if delay_s < 0 or jitter_s < 0:
+            raise ValueError("latency delay/jitter must be >= 0")
+        self.delay_s = delay_s
+        self.jitter_s = jitter_s
+        self._rng = random.Random(seed)
+
+    def due(self, now: float) -> float:
+        if self.jitter_s:
+            return now + self.delay_s + self._rng.random() * self.jitter_s
+        return now + self.delay_s
 
 
 def _hello_frame(node_id: int) -> bytes:
@@ -139,7 +170,13 @@ class _PeerChannel:
     def __init__(self, transport: "TcpTransport", peer_id: int):
         self.transport = transport
         self.peer_id = peer_id
-        self.queue: collections.deque[bytes] = collections.deque()
+        # Without latency emulation the deque holds bare frames; with a
+        # LinkLatency installed it holds (due_monotonic, frame) pairs and
+        # the sender drains only frames whose due time has passed.
+        self.queue: collections.deque = collections.deque()
+        self.latency: LinkLatency | None = transport._link_latency.get(
+            peer_id
+        )
         self.cv = threading.Condition()
         self.closed = False
         self._drain_deadline = 0.0
@@ -171,7 +208,11 @@ class _PeerChannel:
                 self.queue.popleft()
                 self.dropped_overflow += 1
                 _frame_outcome("dropped_overflow")
-            self.queue.append(frame)
+            lat = self.latency
+            if lat is None:
+                self.queue.append(frame)
+            else:
+                self.queue.append((lat.due(time.monotonic()), frame))
             self.enqueued += 1
             _frame_outcome("enqueued")
             self.cv.notify()
@@ -198,15 +239,35 @@ class _PeerChannel:
                     _frame_outcome("dropped_closed", len(self.queue))
                     self.queue.clear()
                     return
+                lat = self.latency
+                if lat is not None and not self.closed:
+                    # Emulated link latency: hold the head frame until its
+                    # due time (closing drains immediately — teardown must
+                    # not wait out a WAN profile).
+                    wait = self.queue[0][0] - time.monotonic()
+                    if wait > 0:
+                        self.cv.wait(timeout=wait)
+                        continue
                 # Coalesce: drain the burst (up to a byte budget) so many
                 # queued frames cost one sendall instead of one syscall
                 # each.  Frames left past the budget go on the next wakeup.
                 frames.clear()
                 budget = _COALESCE_BYTES
-                while self.queue and budget > 0:
-                    frame = self.queue.popleft()
-                    frames.append(frame)
-                    budget -= len(frame)
+                if lat is None:
+                    while self.queue and budget > 0:
+                        frame = self.queue.popleft()
+                        frames.append(frame)
+                        budget -= len(frame)
+                else:
+                    now = time.monotonic()
+                    while self.queue and budget > 0 and (
+                        self.closed or self.queue[0][0] <= now
+                    ):
+                        frame = self.queue.popleft()[1]
+                        frames.append(frame)
+                        budget -= len(frame)
+                    if not frames:
+                        continue  # head not due yet (raced with enqueue)
             entry = self._ensure_connected()
             if entry is None:
                 # Shut down while connecting/backing off: the burst (and
@@ -244,7 +305,11 @@ class _PeerChannel:
                     space = self.transport.queue_depth - len(self.queue)
                     keep = frames[: max(space, 0)]
                     for frame in reversed(keep):
-                        self.queue.appendleft(frame)
+                        # Already-due placeholder on latency links: the
+                        # emulated delay was served before the first try.
+                        self.queue.appendleft(
+                            frame if self.latency is None else (0.0, frame)
+                        )
                     dropped = len(frames) - len(keep)
                     if dropped:
                         self.dropped_overflow += dropped
@@ -362,6 +427,9 @@ class TcpTransport:
         self.dial_timeout = dial_timeout
         # Fault-injection seam (TransportFault); None in production.
         self.fault: TransportFault | None = None
+        # peer id -> LinkLatency for emulated WAN links (see
+        # set_link_latency); empty in production.
+        self._link_latency: dict[int, LinkLatency] = {}
         # Frame-encoder scratch: per-thread bytearray (multiple processor
         # stage threads may send concurrently) plus the precomputed source
         # id varint every outbound frame starts with.
@@ -414,6 +482,32 @@ class TcpTransport:
         with self._lock:
             self._peers[peer_id] = tuple(address)
 
+    def set_link_latency(
+        self,
+        peer_id: int,
+        delay_s: float,
+        jitter_s: float = 0.0,
+        seed: int = 0,
+    ) -> None:
+        """Install emulated one-way latency on the outbound link to
+        ``peer_id`` (``delay_s`` fixed + uniform jitter up to
+        ``jitter_s``, deterministic per seed).  Takes effect for frames
+        enqueued after the call; frames already queued keep whatever
+        representation they were enqueued with, so set latency before
+        traffic starts (the cluster runner configures links at boot)."""
+        lat = LinkLatency(delay_s, jitter_s, seed=seed ^ (peer_id << 8))
+        with self._lock:
+            self._link_latency[peer_id] = lat
+            channel = self._channels.get(peer_id)
+        if channel is not None:
+            with channel.cv:
+                if channel.queue:
+                    raise RuntimeError(
+                        "set_link_latency on a link with queued frames"
+                    )
+                channel.latency = lat
+                channel.cv.notify()
+
     # -- outbound --------------------------------------------------------------
 
     def link(self) -> Link:
@@ -465,6 +559,28 @@ class TcpTransport:
             self.dropped_unknown += 1
             _frame_outcome("dropped_unknown")
             return  # unknown peer: dropped, like any unreachable host
+        channel.enqueue(frame)
+
+    def propose(self, dest: int, request: pb.Request) -> None:
+        """Client-side submission: frame a bare pb.Request under the
+        reserved ``_PROPOSE_SRC`` id and enqueue it to ``dest`` (which
+        must be ``connect``-ed first).  The receiving transport hands the
+        request to its node's ``propose`` — the open-loop load generator
+        and the cluster supervisor submit through this instead of opening
+        sockets of their own.  Fire-and-forget like ``send``: duplicate
+        submission on timeout is the client model, and the protocol's
+        dedup absorbs it."""
+        payload = (
+            wire.encode_varint(_PROPOSE_SRC)
+            + wire.encode_varint(self.node_id)
+            + pb.encode(request)
+        )
+        frame = _LEN.pack(len(payload)) + payload
+        channel = self._channel(dest)
+        if channel is None:
+            self.dropped_unknown += 1
+            _frame_outcome("dropped_unknown")
+            return
         channel.enqueue(frame)
 
     def counters(self) -> dict:
@@ -569,7 +685,11 @@ class TcpTransport:
                         time.perf_counter_ns() - remote_ns
                     )
                 return
-            msg = pb.decode(pb.Msg, payload[offset:])
+            if source == _PROPOSE_SRC:
+                _client_ep, offset = wire.decode_varint(payload, offset)
+                request = pb.decode(pb.Request, payload[offset:])
+            else:
+                msg = pb.decode(pb.Msg, payload[offset:])
         except ValueError:
             return  # malformed frame from a faulty peer: dropped
         node = self._node
@@ -578,7 +698,10 @@ class TcpTransport:
         from .node import NodeStopped
 
         try:
-            node.step(source, msg)
+            if source == _PROPOSE_SRC:
+                node.propose(request)
+            else:
+                node.step(source, msg)
         except (ValueError, NodeStopped):
             return  # failed preflight validation / local shutdown: dropped
 

@@ -355,3 +355,99 @@ def test_future_acks_buffered_and_drained():
     crn = ct.client(7).req_no(21)
     assert ack_future.digest in crn.requests
     assert len(ct.msg_buffers[1]) == 0
+
+
+# -- forward-request quorum bookkeeping (regression) ------------------------
+
+
+def test_forward_request_agreement_crosses_weak_quorum():
+    """A ForwardRequest's out-of-band agreement bump must run the same
+    quorum bookkeeping as an ack: a crossing it causes may never be
+    skipped, because nothing retries it later (regression: the bump set
+    the bit but never promoted the certificate)."""
+    ct = make_tracker()
+    r, ack = req()
+    # Node 1's ack creates the request entry with one agreement.
+    ct.step(1, ack_msg(ack))
+    client = ct.client(7)
+    crn = client.req_no(0)
+    assert ack.digest not in crn.weak_requests
+    # Node 2's ForwardRequest bumps agreements to 2 == f+1: the weak
+    # certificate must form right here.
+    fwd = pb.Msg(
+        type=pb.ForwardRequest(request_ack=ack, request_data=r.data)
+    )
+    actions = ct.step(2, fwd)
+    assert actions.hashes, "forward data must still be hash-verified"
+    assert ack.digest in crn.weak_requests
+    assert ack.digest not in crn.strong_requests
+    # The newly-weak request is on the availability list.
+    it = ct.available_list.iterator()
+    seen = []
+    while it.has_next():
+        seen.append(it.next())
+    assert any(req_obj.ack.digest == ack.digest for req_obj in seen)
+
+
+def test_forward_request_agreement_crosses_strong_quorum():
+    ct = make_tracker()
+    r, ack = req()
+    ct.step(1, ack_msg(ack))
+    ct.step(2, ack_msg(ack))  # 2 == f+1 -> weak via the ack path
+    crn = ct.client(7).req_no(0)
+    assert ack.digest in crn.weak_requests
+    assert ack.digest not in crn.strong_requests
+    fwd = pb.Msg(
+        type=pb.ForwardRequest(request_ack=ack, request_data=r.data)
+    )
+    ct.step(3, fwd)  # 3 == 2f+1: the strong certificate must form
+    assert ack.digest in crn.strong_requests
+
+
+# -- small-frame ack deliveries with a live vector mirror (regression) ------
+
+
+def test_small_ack_frames_refresh_the_live_mirror():
+    """Once a large frame has built the _FastAcks mirror, small frames
+    (< 32 acks) take the python loop — which must refresh every touched
+    slot, or the mirror's tick classification goes stale (regression: a
+    newly-weak unstored request stayed TICK_INERT and its fetch
+    machinery never ticked)."""
+    from mirbft_tpu.core.client_tracker import _FastAcks
+
+    ct = make_tracker(network_state(clients=((7, 100),)))
+    assert ct._fast_ok
+    acks = [req(req_no=i)[1] for i in range(40)]
+    # One large frame from node 1 builds the mirror (first-vote rows fall
+    # back to step_ack per row, which itself refreshes each slot).
+    ct.step_ack_many(1, [ack_msg(a) for a in acks])
+    fast = ct._fast
+    assert fast is not None
+    slot = fast.slot_of(7, 0)
+    assert fast.tick_class[slot] == _FastAcks.TICK_INERT  # one vote, no certs
+
+    # A small frame from node 2 (loop path) crosses the weak quorum for
+    # req_nos 0..2: unstored newly-weak requests need fetch ticks, so the
+    # mirror slots must reclassify.
+    ct.step_ack_many(2, [ack_msg(a) for a in acks[:3]])
+    for req_no in range(3):
+        crn = ct.client(7).req_no(req_no)
+        assert acks[req_no].digest in crn.weak_requests
+        s = fast.slot_of(7, req_no)
+        assert fast.tick_class[s] == fast._classify_tick(crn)
+        assert fast.tick_class[s] == _FastAcks.TICK_PYTHON
+    # Untouched slots keep their old class.
+    assert fast.tick_class[fast.slot_of(7, 10)] == _FastAcks.TICK_INERT
+
+    # The reclassified slots actually tick: the fetch machinery for an
+    # unstored weak request emits FetchRequest sends within its backoff.
+    fetched = False
+    for _ in range(64):
+        actions = ct.tick()
+        if any(
+            isinstance(send.msg.type, pb.FetchRequest)
+            for send in actions.sends
+        ):
+            fetched = True
+            break
+    assert fetched, "newly-weak unstored request never fetched after small frame"

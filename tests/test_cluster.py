@@ -1,0 +1,156 @@
+"""Multi-process cluster runner gates.
+
+Tier-1: the WAN profile map, the atomic handshake-file helpers, and the
+mp chaos matrix's shape (including the unsupported-fault rejections).
+Slow: a real supervisor lifecycle — spawn four worker processes, readiness
+handshake, commit under broadcast submission, SIGKILL + restart-from-disk,
+teardown — and one full mp chaos scenario.  The slow tests fork real
+``python -m mirbft_tpu.cluster`` processes, so they stay out of tier-1.
+"""
+
+import os
+import time
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.cluster import (
+    MP_SMOKE_NAMES,
+    WAN_PROFILES,
+    ClusterSupervisor,
+    mp_matrix,
+    profile_latency,
+    retry_storm_scenario,
+)
+from mirbft_tpu.cluster.chaos_mp import _reject_unsupported, run_mp_scenario
+from mirbft_tpu.cluster.worker import read_json, write_json_atomic
+from mirbft_tpu.chaos.scenarios import Scenario, StorageFault
+
+
+# -- tier-1: profiles, handshake files, matrix shape -------------------------
+
+
+def test_wan_profiles_lower_to_per_link_latency_maps():
+    assert set(WAN_PROFILES) == {"lan", "wan", "geo"}
+    assert profile_latency("lan", 4) == {}  # loopback baseline: no emulation
+    wan = profile_latency("wan", 4)
+    assert set(wan) == {0, 1, 2, 3}
+    assert wan[2] == {"delay_ms": 30.0, "jitter_ms": 5.0}
+    geo = profile_latency("geo", 3)
+    assert geo[0]["delay_ms"] > wan[0]["delay_ms"]
+    with pytest.raises(ValueError):
+        profile_latency("lunar", 4)
+
+
+def test_handshake_files_are_atomic_and_torn_reads_are_none(tmp_path):
+    path = str(tmp_path / "address.json")
+    assert read_json(path) is None  # absent
+    write_json_atomic(path, {"pid": 42, "transport_port": 9})
+    assert read_json(path) == {"pid": 42, "transport_port": 9}
+    assert not os.path.exists(path + ".tmp")  # no droppings
+    # A torn/partial file (a non-atomic writer mid-flight) reads as None
+    # rather than raising into the poll loop.
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write('{"pid": 4')
+    assert read_json(path) is None
+
+
+def test_mp_matrix_is_the_smoke_pair_plus_the_dedup_storm():
+    names = [scenario.name for scenario in mp_matrix()]
+    assert names[: len(MP_SMOKE_NAMES)] == list(MP_SMOKE_NAMES)
+    assert "retry-storm-dedup" in names
+    storm = retry_storm_scenario()
+    assert storm.node_count == 4
+    assert not storm.crashes and not storm.partitions
+
+
+def test_mp_driver_rejects_faults_it_cannot_lower():
+    with pytest.raises(ValueError):
+        _reject_unsupported(
+            Scenario(
+                name="storage",
+                description="",
+                storage_faults=(
+                    StorageFault(at_ms=0, node=0, restart_delay_ms=1000),
+                ),
+            )
+        )
+    with pytest.raises(ValueError):
+        _reject_unsupported(Scenario(name="signed", description="", signed=True))
+    with pytest.raises(ValueError):
+        _reject_unsupported(Scenario(name="lossy", description="", drop_pct=10))
+    for scenario in mp_matrix():
+        _reject_unsupported(scenario)  # the shipped matrix must be clean
+
+
+# -- slow: real worker processes ---------------------------------------------
+
+
+def _wait_for(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.slow
+def test_supervisor_boot_commit_kill_restart_teardown(tmp_path):
+    sup = ClusterSupervisor(
+        node_count=4, client_ids=[1], root=str(tmp_path / "cluster")
+    )
+    try:
+        sup.start(timeout_s=120.0)
+        # Readiness handshake: every node's /healthz reports ready.
+        for node_id in sup.node_ids:
+            health = sup.healthz(node_id)
+            assert health and health.get("ready") is True, health
+
+        # A broadcast submission commits on every node.
+        request = pb.Request(client_id=1, req_no=0, data=b"mp-smoke")
+        for node_id in sup.node_ids:
+            sup.submit(node_id, request)
+
+        def all_committed():
+            return all(
+                (1, 0) in {(c, q) for (c, q, _s) in sup.committed(n)}
+                for n in sup.node_ids
+            )
+
+        _wait_for(all_committed, 60.0, "commit on all four nodes")
+
+        # SIGKILL one node: process dies, the rest stay up.
+        sup.kill(3, graceful=False)
+        _wait_for(lambda: 3 not in sup.alive_nodes(), 10.0, "node 3 death")
+        assert sup.healthz(3) is None
+        assert sorted(sup.alive_nodes()) == [0, 1, 2]
+
+        # Restart from disk: the worker reboots via Node.restart, re-binds
+        # its original transport port, and reports ready again.
+        sup.restart(3)
+        _wait_for(lambda: 3 in sup.alive_nodes(), 10.0, "node 3 restart")
+        health = sup.healthz(3)
+        assert health and health.get("ready") is True
+
+        # The restarted node still converges: a fresh request commits
+        # everywhere, including on node 3's recovered log.
+        request2 = pb.Request(client_id=1, req_no=1, data=b"post-restart")
+        for node_id in sup.node_ids:
+            sup.submit(node_id, request2)
+
+        def node3_caught_up():
+            return (1, 1) in {(c, q) for (c, q, _s) in sup.committed(3)}
+
+        _wait_for(node3_caught_up, 60.0, "post-restart commit on node 3")
+    finally:
+        sup.teardown()
+    assert sup.alive_nodes() == []
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_mp_chaos_crash_restart_scenario():
+    crash = next(s for s in mp_matrix() if s.name == "crash-restart")
+    result = run_mp_scenario(crash, seed=0, budget_s=240.0)
+    assert result.passed, result.violation

@@ -422,3 +422,12 @@ def test_pallas_verify_pipeline_matches_oracle():
         got = verify_batch_pallas(pks, msgs, sigs)
     assert got.tolist() == expect
     assert any(expect) and not all(expect)
+
+
+def test_launch_rows_rejects_an_empty_batch():
+    """launch_rows pads a batch by replicating rows[0]; an empty list
+    must fail loudly instead of raising IndexError mid-padding."""
+    from mirbft_tpu.ops.ed25519_pallas import launch_rows
+
+    with pytest.raises(ValueError, match="at least one"):
+        launch_rows([])

@@ -288,3 +288,47 @@ def test_linter_bans_raw_threads_in_processor_outside_spawn_stage(tmp_path):
             REPO / "mirbft_tpu" / "runtime" / "processor.py"
         )
     )
+
+
+def test_linter_confines_process_management_to_cluster(tmp_path):
+    """W11: subprocess/multiprocessing imports belong to the cluster
+    supervisor; a stray Popen elsewhere forks workers that escape the
+    supervisor's lifecycle, log capture, and teardown sweep."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "runtime" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("import subprocess\nx = subprocess\n")
+    findings = lint.check_file(outside)
+    assert any("W11" in line for line in findings), findings
+
+    fromstyle = tmp_path / "mirbft_tpu" / "chaos" / "sneaky2.py"
+    fromstyle.parent.mkdir(parents=True)
+    fromstyle.write_text("from multiprocessing import Process\nx = Process\n")
+    assert any("W11" in line for line in lint.check_file(fromstyle))
+
+    submodule = tmp_path / "mirbft_tpu" / "core" / "sneaky3.py"
+    submodule.parent.mkdir(parents=True)
+    submodule.write_text(
+        "from multiprocessing.connection import Client\nx = Client\n"
+    )
+    assert any("W11" in line for line in lint.check_file(submodule))
+
+    inside = tmp_path / "mirbft_tpu" / "cluster" / "fine.py"
+    inside.parent.mkdir(parents=True)
+    inside.write_text("import subprocess\nx = subprocess\n")
+    assert not any("W11" in line for line in lint.check_file(inside))
+
+    # The real supervisor is the sanctioned Popen user.
+    assert not any(
+        "W11" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "cluster" / "supervisor.py"
+        )
+    )
+
+    # Tests and tools are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text("import subprocess\nx = subprocess\n")
+    assert not any("W11" in line for line in lint.check_file(tests_ok))

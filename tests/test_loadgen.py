@@ -1,0 +1,387 @@
+"""Load generator gates: arrival determinism, client models, the SLO
+artifact + absolute gate, the ``obsv --diff`` relative gate (nonzero exit
+on an injected p95 regression), the generator's retry/timeout accounting
+against a scripted cluster, and the tier-1 in-process smoke — including
+the deterministic retry-storm dedup test (every unique request commits
+exactly once while ``mirbft_request_duplicates_total`` accounts for the
+absorbed resubmissions)."""
+
+import json
+import time
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.loadgen import (
+    BurstyArrivals,
+    ClientModel,
+    DiurnalArrivals,
+    InProcessCluster,
+    LoadGenerator,
+    PoissonArrivals,
+    StepResult,
+    percentile_ms,
+    slo,
+    standard_client_models,
+)
+from mirbft_tpu.obsv import hooks
+from mirbft_tpu.obsv.__main__ import main as obsv_main
+
+
+# -- arrival processes -------------------------------------------------------
+
+
+def test_poisson_arrivals_deterministic_sorted_and_rate_shaped():
+    a = PoissonArrivals(rate_per_sec=200.0, seed=3)
+    first = a.offsets(5.0)
+    assert first == PoissonArrivals(200.0, seed=3).offsets(5.0)
+    assert first == sorted(first)
+    assert all(0.0 <= t < 5.0 for t in first)
+    # ~1000 expected; Poisson sd ~32, so a wide band is still a real check.
+    assert 700 < len(first) < 1300
+    assert PoissonArrivals(200.0, seed=4).offsets(5.0) != first
+
+
+def test_poisson_rejects_nonpositive_rate():
+    with pytest.raises(ValueError):
+        PoissonArrivals(0.0)
+
+
+def test_bursty_arrivals_land_only_in_on_windows():
+    a = BurstyArrivals(20.0, burst_factor=4.0, on_s=0.5, off_s=1.0, seed=1)
+    offsets = a.offsets(6.0)
+    assert offsets == sorted(offsets)
+    assert offsets, "six seconds of bursts must produce arrivals"
+    period = a.on_s + a.off_s
+    for t in offsets:
+        assert (t % period) < a.on_s, f"arrival {t} inside an off window"
+
+
+def test_diurnal_arrivals_follow_the_ramp():
+    a = DiurnalArrivals(5.0, 100.0, period_s=4.0, seed=2)
+    offsets = a.offsets(8.0)
+    assert offsets == sorted(offsets)
+    assert offsets == DiurnalArrivals(5.0, 100.0, period_s=4.0, seed=2).offsets(8.0)
+    # Peak half-periods (phase around period/2) must see far more arrivals
+    # than trough half-periods (phase around 0).
+    trough = sum(1 for t in offsets if (t % 4.0) < 1.0 or (t % 4.0) > 3.0)
+    peak = sum(1 for t in offsets if 1.0 <= (t % 4.0) <= 3.0)
+    assert peak > 3 * max(trough, 1)
+    assert a.rate_at(0.0) == pytest.approx(5.0)
+    assert a.rate_at(2.0) == pytest.approx(100.0)
+
+
+# -- client models -----------------------------------------------------------
+
+
+def test_client_model_payload_sizes_and_determinism():
+    import random
+
+    fixed = ClientModel(payload_bytes=64)
+    assert len(fixed.payload(random.Random(0), 7)) == 64
+    # Same (client, req_no) must produce identical bytes: dedup depends on
+    # resubmissions hashing to the same digest.
+    assert fixed.payload(random.Random(0), 7) == fixed.payload(random.Random(9), 7)
+
+    mixed = ClientModel(payload_choices=(16, 256))
+    sizes = {len(mixed.payload(random.Random(i), i)) for i in range(32)}
+    assert sizes <= {16, 256} and len(sizes) == 2
+
+
+def test_client_model_validation():
+    with pytest.raises(ValueError):
+        ClientModel(payload_bytes=0)
+    with pytest.raises(ValueError):
+        ClientModel(submit_lag_s=-0.1)
+    with pytest.raises(ValueError):
+        ClientModel(retry_timeout_s=0.0)
+    with pytest.raises(ValueError):
+        ClientModel(retry_fanout=0)
+
+
+def test_standard_client_models_cover_the_three_behaviours():
+    models = standard_client_models([1, 2, 3, 4])
+    assert set(models) == {1, 2, 3, 4}
+    assert models[1] == ClientModel()  # honest
+    assert models[2].payload_choices and models[2].submit_lag_s > 0  # slow+mixed
+    assert models[3].retry_timeout_s is not None  # stormy
+    assert models[4] == models[1]  # round-robin wraps
+
+
+# -- percentiles and the SLO artifact ---------------------------------------
+
+
+def test_percentile_nearest_rank():
+    assert percentile_ms([], 0.95) == 0.0
+    sample = list(range(1, 101))  # 1..100
+    assert percentile_ms(sample, 0.50) == 50
+    assert percentile_ms(sample, 0.95) == 95
+    assert percentile_ms(sample, 0.99) == 99
+    assert percentile_ms([42.0], 0.99) == 42.0
+
+
+def _step(name, p95=100.0, committed=90, offered=50.0, timed_out=0):
+    step = StepResult(name=name, offered_rate_per_sec=offered, duration_s=2.0)
+    step.submitted = committed + timed_out
+    step.committed = committed
+    step.timed_out = timed_out
+    step.goodput_per_sec = committed / step.duration_s
+    step.p50_ms = p95 / 2
+    step.p95_ms = p95
+    step.p99_ms = p95 * 1.2
+    return step
+
+
+def test_slo_artifact_roundtrip_and_absolute_gate(tmp_path):
+    doc = slo.artifact([_step("poisson-50")], cluster="test", nodes=4)
+    assert doc["schema"] == slo.SCHEMA
+    assert doc["meta"] == {"cluster": "test", "nodes": 4}
+    path = tmp_path / "slo.json"
+    slo.write_artifact(str(path), doc)
+    assert slo.load_artifact(str(path)) == doc
+
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        slo.load_artifact(str(bad))
+
+    assert slo.check_slo(doc, p95_ms=200.0, min_goodput_ratio=0.5) == []
+    violations = slo.check_slo(
+        slo.artifact([_step("hot", p95=500.0, committed=10, timed_out=3)]),
+        p95_ms=200.0,
+        p99_ms=250.0,
+        min_goodput_ratio=0.5,
+        max_timed_out=0,
+    )
+    assert len(violations) == 4  # p95, p99, goodput floor, stranded reqs
+    assert any("p95" in v for v in violations)
+    assert any("never committed" in v for v in violations)
+
+
+# -- the relative gate: obsv --diff on SLO artifacts -------------------------
+
+
+def test_diff_gate_exits_nonzero_on_injected_p95_regression(tmp_path, capsys):
+    baseline = tmp_path / "a.json"
+    candidate = tmp_path / "b.json"
+    slo.write_artifact(
+        str(baseline), slo.artifact([_step("poisson-50", p95=100.0)])
+    )
+    slo.write_artifact(
+        str(candidate), slo.artifact([_step("poisson-50", p95=180.0)])
+    )
+    rc = obsv_main(["--diff", str(baseline), str(candidate), "--threshold", "10"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    report = json.loads(out.strip().splitlines()[-1])
+    regressed = {entry["series"] for entry in report["regressions"]}
+    assert "step.poisson-50.p95_ms" in regressed
+
+    # Identical artifacts pass.
+    assert obsv_main(["--diff", str(baseline), str(baseline)]) == 0
+    capsys.readouterr()
+
+    # A p95 *improvement* must not gate (direction awareness).
+    slo.write_artifact(
+        str(candidate), slo.artifact([_step("poisson-50", p95=50.0)])
+    )
+    assert obsv_main(["--diff", str(baseline), str(candidate)]) == 0
+    capsys.readouterr()
+
+    # A goodput drop gates in the other direction.
+    slo.write_artifact(
+        str(candidate), slo.artifact([_step("poisson-50", p95=100.0, committed=40)])
+    )
+    rc = obsv_main(["--diff", str(baseline), str(candidate)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    report = json.loads(out.strip().splitlines()[-1])
+    regressed = {entry["series"] for entry in report["regressions"]}
+    assert "step.poisson-50.goodput_per_sec" in regressed
+
+
+def test_diff_gate_reads_the_slo_artifact_embedded_in_bench_json(tmp_path, capsys):
+    """bench.py embeds the live_mp artifact under ``loadgen``; a p95
+    regression inside it must fail the whole-bench diff."""
+    base = {"metric": 1000.0, "loadgen": slo.artifact([_step("mp", p95=100.0)])}
+    cand = {"metric": 1000.0, "loadgen": slo.artifact([_step("mp", p95=400.0)])}
+    a, b = tmp_path / "bench_a.json", tmp_path / "bench_b.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(cand))
+    rc = obsv_main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    report = json.loads(out.strip().splitlines()[-1])
+    regressed = {entry["series"] for entry in report["regressions"]}
+    assert "loadgen.step.mp.p95_ms" in regressed
+
+
+# -- the generator against a scripted cluster --------------------------------
+
+
+class _ScriptedCluster:
+    """Commits a request on its Nth submission — deterministic retry bait."""
+
+    def __init__(self, commit_on_send: int):
+        self.node_ids = [0, 1, 2, 3]
+        self.commit_on_send = commit_on_send
+        self.sends: dict = {}
+        self._commits: list = []
+
+    def submit(self, node_id, request):
+        key = (request.client_id, request.req_no)
+        self.sends[key] = self.sends.get(key, 0) + 1
+        if self.sends[key] == self.commit_on_send:
+            self._commits.append(
+                (node_id, request.client_id, request.req_no, 1, time.monotonic_ns())
+            )
+
+    def poll_commits(self):
+        out = self._commits
+        self._commits = []
+        return out
+
+
+def test_generator_retry_storm_counts_duplicates_not_goodput():
+    # First submission broadcasts to 4 nodes; commit_on_send=5 means no
+    # request commits until its first retry lands — every commit is
+    # retry-won, and every retry is accounted as a duplicate.
+    cluster = _ScriptedCluster(commit_on_send=5)
+    models = {1: ClientModel(retry_timeout_s=0.05, retry_fanout=2)}
+    gen = LoadGenerator(cluster, models, seed=5)
+    result = gen.run_step(
+        "storm", PoissonArrivals(40.0, seed=5), duration_s=0.4, drain_s=5.0
+    )
+    assert result.submitted > 0
+    assert result.committed == result.submitted
+    assert result.timed_out == 0
+    assert result.duplicates > 0
+    # Latency is first-submit to commit: at least one retry timeout long.
+    assert result.p50_ms >= 40.0
+    assert result.goodput_per_sec == pytest.approx(
+        result.committed / result.duration_s
+    )
+
+
+def test_generator_counts_never_committed_requests_as_timed_out():
+    cluster = _ScriptedCluster(commit_on_send=10**9)
+    gen = LoadGenerator(cluster, {1: ClientModel()}, seed=0)
+    result = gen.run_step(
+        "dead", PoissonArrivals(50.0, seed=1), duration_s=0.2, drain_s=0.1
+    )
+    assert result.submitted > 0
+    assert result.committed == 0
+    assert result.timed_out == result.submitted
+    assert result.goodput_per_sec == 0.0
+
+
+def test_generator_requires_a_client_model():
+    with pytest.raises(ValueError):
+        LoadGenerator(_ScriptedCluster(1), {})
+
+
+def test_generator_req_nos_persist_across_steps():
+    cluster = _ScriptedCluster(commit_on_send=1)
+    gen = LoadGenerator(cluster, {1: ClientModel()}, seed=0)
+    first = gen.run_step("s1", PoissonArrivals(30.0, seed=2), 0.2, drain_s=2.0)
+    second = gen.run_step("s2", PoissonArrivals(30.0, seed=3), 0.2, drain_s=2.0)
+    assert first.submitted and second.submitted
+    req_nos = sorted(q for (_c, q) in cluster.sends)
+    assert req_nos == list(range(first.submitted + second.submitted))
+
+
+# -- in-process cluster smoke (the tier-1 end-to-end path) -------------------
+
+
+def _wait_for(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_inprocess_loadgen_smoke():
+    """The full open-loop pipeline — arrivals, broadcast submission,
+    commit observation, latency tracking — against four real runtime
+    nodes in one process."""
+    with InProcessCluster(node_count=4, client_ids=[1, 2]) as cluster:
+        gen = LoadGenerator(
+            cluster, {1: ClientModel(), 2: ClientModel()}, seed=7
+        )
+        result = gen.run_step(
+            "smoke", PoissonArrivals(25.0, seed=7), duration_s=1.0, drain_s=30.0
+        )
+        cluster.check()
+    assert result.submitted > 0
+    assert result.committed == result.submitted, (
+        f"{result.timed_out} of {result.submitted} requests never committed"
+    )
+    assert result.timed_out == 0
+    assert len(result.latencies_ms) == result.committed
+    assert all(lat >= 0.0 for lat in result.latencies_ms)
+    assert result.p95_ms >= result.p50_ms > 0.0
+    assert result.goodput_per_sec > 0.0
+
+
+def test_retry_storm_commits_exactly_once_and_accounts_duplicates():
+    """Satellite gate: a deterministic retry storm — every request
+    resubmitted to every node after committing — must change nothing
+    (exactly-once per node) while ``mirbft_request_duplicates_total``
+    records the absorbed resubmissions."""
+    metrics, _tracer = hooks.enable()
+
+    def dup_total():
+        fam = metrics.snapshot().get("mirbft_request_duplicates_total")
+        return sum(s["value"] for s in fam["series"]) if fam else 0
+
+    try:
+        with InProcessCluster(node_count=4, client_ids=[1, 2]) as cluster:
+            requests = [
+                pb.Request(
+                    client_id=client_id,
+                    req_no=req_no,
+                    data=b"%d:%d" % (client_id, req_no),
+                )
+                for client_id in (1, 2)
+                for req_no in range(4)
+            ]
+            expected = {(r.client_id, r.req_no) for r in requests}
+            for request in requests:
+                for node_id in cluster.node_ids:
+                    cluster.submit(node_id, request)
+
+            def committed_everywhere():
+                cluster.check()
+                return all(
+                    {(c, q) for (c, q, _s) in rep.app_log.commits} >= expected
+                    for rep in cluster.replicas
+                )
+
+            _wait_for(committed_everywhere, 60.0, "initial commits")
+            before = dup_total()
+
+            # The storm: two more full broadcast rounds of every request.
+            for _round in range(2):
+                for request in requests:
+                    for node_id in cluster.node_ids:
+                        cluster.submit(node_id, request)
+
+            # Every storm submission is absorbed by dedup, and the
+            # absorption is visible in the catalog counter.
+            _wait_for(
+                lambda: dup_total() - before >= len(requests),
+                30.0,
+                "duplicate accounting",
+            )
+            time.sleep(0.3)  # grace: a wrongly re-proposed request would commit now
+            cluster.check()
+            for rep in cluster.replicas:
+                pairs = [(c, q) for (c, q, _s) in rep.app_log.commits]
+                assert len(pairs) == len(set(pairs)), (
+                    f"node {rep.node_id} committed a request twice"
+                )
+                assert set(pairs) == expected
+    finally:
+        hooks.disable()

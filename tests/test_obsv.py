@@ -656,7 +656,7 @@ def test_node_endpoints_round_trip():
 
         status_code, text = _get(base + "/healthz")
         assert status_code == 200
-        assert json.loads(text) == {"ok": True, "node_id": 0}
+        assert json.loads(text) == {"ok": True, "node_id": 0, "ready": True}
 
         from urllib.error import HTTPError
 

@@ -39,6 +39,12 @@ enforces the defect classes that have actually bitten BFT codebases:
   ``_spawn_stage`` helper — stage threads must go through the single
   creation point so naming (``proc-pipe-*``), daemonization, and the
   leak gate stay uniform.  Scoped to ``mirbft_tpu/``.
+- W11 ``subprocess``/``multiprocessing`` outside ``mirbft_tpu/cluster/``
+  — process management (spawn, readiness handshake, kill/restart,
+  teardown) is the cluster supervisor's whole job; a stray Popen or
+  Process elsewhere forks workers that escape the supervisor's
+  lifecycle, log capture, and teardown sweep.  Scoped to
+  ``mirbft_tpu/`` (tests, tools, and bench may fork freely).
 
 Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
 Also enforced in CI-equivalent form by ``tests/test_lint.py``.
@@ -165,6 +171,21 @@ def _in_fsync_ban_scope(path: Path) -> bool:
     return "mirbft_tpu/" in posix and not any(
         posix.endswith(allowed) for allowed in FSYNC_ALLOWED_FILES
     )
+
+
+# The only tree allowed to manage OS processes: the cluster supervisor
+# owns spawn/handshake/kill/restart/teardown for process-per-node runs.
+PROCESS_ALLOWED_TREE = "mirbft_tpu/cluster/"
+
+# Modules whose import anywhere else in mirbft_tpu/ trips W11.
+PROCESS_MODULES = ("subprocess", "multiprocessing")
+
+
+def _in_process_ban_scope(path: Path) -> bool:
+    """True for mirbft_tpu files where W11 bans process-management
+    imports."""
+    posix = path.resolve().as_posix()
+    return "mirbft_tpu/" in posix and PROCESS_ALLOWED_TREE not in posix
 
 
 def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
@@ -320,6 +341,27 @@ def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
                     f"{path}:{node.lineno}: W10 os.fsync outside "
                     "runtime/storage.py (durability goes through the "
                     "stores' sync()/sync_token() group-commit API)"
+                )
+        if _in_process_ban_scope(path):
+            hit = False
+            if isinstance(node, ast.Import):
+                hit = any(
+                    alias.name in PROCESS_MODULES
+                    or alias.name.startswith(tuple(m + "." for m in PROCESS_MODULES))
+                    for alias in node.names
+                )
+            elif isinstance(node, ast.ImportFrom):
+                hit = node.module is not None and (
+                    node.module in PROCESS_MODULES
+                    or node.module.startswith(
+                        tuple(m + "." for m in PROCESS_MODULES)
+                    )
+                )
+            if hit:
+                findings.append(
+                    f"{path}:{node.lineno}: W11 subprocess/multiprocessing "
+                    "outside cluster/ (process lifecycle goes through the "
+                    "cluster supervisor)"
                 )
         if in_thread_ban_file and isinstance(node, ast.Call):
             func = node.func
