@@ -46,6 +46,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -146,7 +147,11 @@ class ClusterSupervisor:
             for n in range(node_count)
         ]
         self.proxies: dict = {}  # (src, dst) -> PartitionProxy
-        self._client_transport: TcpTransport | None = None
+        # Guards the client transport handle: submit() runs on load
+        # generator threads while teardown() runs on the driver thread,
+        # and an unguarded check-then-use would race the close-and-None.
+        self._lock = threading.Lock()
+        self._client_transport: TcpTransport | None = None  # guarded-by: _lock
         self._started = False
 
     # -- boot ----------------------------------------------------------------
@@ -293,7 +298,7 @@ class ClusterSupervisor:
             self._publish_peers(handle.node_id)
         for handle in self.nodes:
             self._wait_ready(handle, deadline)
-        self._client_transport = TcpTransport(
+        client_transport = TcpTransport(
             _CLIENT_NODE_ID,
             port=0,
             backoff_base=0.02,
@@ -301,9 +306,11 @@ class ClusterSupervisor:
             dial_timeout=1.0,
         )
         for handle in self.nodes:
-            self._client_transport.connect(
+            client_transport.connect(
                 handle.node_id, ("127.0.0.1", handle.transport_port)
             )
+        with self._lock:
+            self._client_transport = client_transport
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -376,10 +383,18 @@ class ClusterSupervisor:
 
     def submit(self, node_id: int, request: pb.Request) -> None:
         """Ship one client request to one node (fire-and-forget; the
-        transport's reconnect backoff absorbs a down target)."""
-        if self._client_transport is None:
+        transport's reconnect backoff absorbs a down target).
+
+        Thread-safe against teardown(): the handle is snapshotted under
+        the lock, so a concurrent teardown yields either this clean
+        RuntimeError or a harmless propose into a closing transport
+        (frames to a closed transport are dropped and counted) — never
+        an AttributeError from the check-then-use window."""
+        with self._lock:
+            client_transport = self._client_transport
+        if client_transport is None:
             raise RuntimeError("cluster not started")
-        self._client_transport.propose(node_id, request)
+        client_transport.propose(node_id, request)
 
     # -- commit observation --------------------------------------------------
 
@@ -435,9 +450,11 @@ class ClusterSupervisor:
 
     def teardown(self) -> None:
         """Stop everything; idempotent."""
-        if self._client_transport is not None:
-            self._client_transport.close(0)
+        with self._lock:
+            client_transport = self._client_transport
             self._client_transport = None
+        if client_transport is not None:
+            client_transport.close(0)
         for handle in self.nodes:
             if handle.alive:
                 handle.process.send_signal(signal.SIGTERM)

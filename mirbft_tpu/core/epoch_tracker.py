@@ -323,7 +323,10 @@ class EpochTracker:
         # f+1 *distinct remote* nodes — counting ourselves (as the
         # reference does, epoch_tracker.go:376-382) would let f byzantine
         # nodes poison the jump target.
-        for max_epoch in set(self.max_epochs.values()):
+        # sorted() keeps the scan order replay-stable (D104): the final
+        # max_correct_epoch is order-independent, but a deterministic
+        # trace must not depend on set iteration order.
+        for max_epoch in sorted(set(self.max_epochs.values())):
             if max_epoch <= self.max_correct_epoch:
                 continue
             matches = sum(1 for m in self.max_epochs.values() if m >= max_epoch)

@@ -421,9 +421,9 @@ class PipelinedProcessor(SerialProcessor):
         self.on_results = None
         self._stop = threading.Event()
         self._mutex = threading.Lock()
-        self._error: BaseException | None = None
-        self._closed = False
-        self._inflight = 0
+        self._error: BaseException | None = None  # guarded-by: _mutex
+        self._closed = False  # guarded-by: _mutex
+        self._inflight = 0  # guarded-by: _mutex
         self._inflight_cv = threading.Condition(self._mutex)
         self._persist_q = queue_mod.Queue(maxsize=self._QUEUE_DEPTH)
         self._barrier_q = queue_mod.Queue(maxsize=self._QUEUE_DEPTH)

@@ -76,12 +76,12 @@ class _GroupCommit:
         self._batches_metric = batches_metric
         self._wait_metric = wait_metric
         self._cv = threading.Condition()
-        self._requested = 0
-        self._completed = 0
-        self._issue_ts: dict[int, float] = {}
-        self._error: BaseException | None = None
-        self._stopping = False
-        self._thread: threading.Thread | None = None
+        self._requested = 0  # guarded-by: _cv
+        self._completed = 0  # guarded-by: _cv
+        self._issue_ts: dict[int, float] = {}  # guarded-by: _cv
+        self._error: BaseException | None = None  # guarded-by: _cv
+        self._stopping = False  # guarded-by: _cv
+        self._thread: threading.Thread | None = None  # guarded-by: _cv
 
     def token(self) -> int:
         with self._cv:
@@ -176,11 +176,11 @@ class FileWal:
         self.seg_dir = os.path.join(path, "segments")
         os.makedirs(self.seg_dir, exist_ok=True)
         self._head_path = os.path.join(path, "head")
-        self._head_index = self._read_head()
-        self._entries = self._load_from_disk()  # [(index, entry)]
-        self._active = None  # open file handle for appends
-        self._active_size = 0
-        self._needs_sync = False
+        self._head_index = self._read_head()  # guarded-by: _lock
+        self._entries = self._load_from_disk()  # guarded-by: _lock
+        self._active = None  # guarded-by: _lock
+        self._active_size = 0  # guarded-by: _lock
+        self._needs_sync = False  # guarded-by: _lock
         # Fault-injection seam (chaos/live.py): called with no arguments
         # immediately before every fsync; raising OSError from it models a
         # failing disk.  None in production.
@@ -211,7 +211,7 @@ class FileWal:
                 names.append(int(name[:-4]))
         return sorted(names)
 
-    def _load_from_disk(self):
+    def _load_from_disk(self):  # holds: _lock
         entries = []
         for first in self._segments():
             path = os.path.join(self.seg_dir, f"{first}.wal")
@@ -231,13 +231,19 @@ class FileWal:
         return [(i, e) for i, e in entries if i >= self._head_index]
 
     def load_all(self, for_each) -> None:
-        """Invoke for_each(index, pb.Persistent) over the live log."""
-        for index, entry in self._entries:
+        """Invoke for_each(index, pb.Persistent) over the live log.
+
+        Snapshots under the lock, then calls back outside it: replay
+        callbacks re-enter the stores (e.g. writing during recovery),
+        and holding _lock across them would deadlock."""
+        with self._lock:
+            entries = list(self._entries)
+        for index, entry in entries:
             for_each(index, entry)
 
     # -- runtime interface ---------------------------------------------------
 
-    def _open_active(self, first_index: int):
+    def _open_active(self, first_index: int):  # holds: _lock
         path = os.path.join(self.seg_dir, f"{first_index}.wal")
         created = not os.path.exists(path)
         self._active = open(path, "ab")
@@ -249,7 +255,7 @@ class FileWal:
         with self._lock:
             self._write_locked(index, entry)
 
-    def _write_locked(self, index: int, entry: pb.Persistent) -> None:
+    def _write_locked(self, index: int, entry: pb.Persistent) -> None:  # holds: _lock
         if self._entries and index != self._entries[-1][0] + 1:
             raise CorruptWal(
                 f"non-contiguous append: {index} after {self._entries[-1][0]}"
@@ -274,7 +280,7 @@ class FileWal:
         with self._lock:
             self._truncate_locked(index)
 
-    def _truncate_locked(self, index: int) -> None:
+    def _truncate_locked(self, index: int) -> None:  # holds: _lock
         self._head_index = index
         with open(self._head_path + ".tmp", "wb") as f:
             f.write(str(index).encode())
@@ -363,10 +369,10 @@ class FileRequestStore:
         self.path = path
         os.makedirs(path, exist_ok=True)
         self._log_path = os.path.join(path, "requests.log")
-        self._index: dict[bytes, tuple] = {}  # key -> (ack, data)
+        self._index: dict[bytes, tuple] = {}  # guarded-by: _lock
         self._replay()
         self._compact()
-        self._file = open(self._log_path, "ab")
+        self._file = open(self._log_path, "ab")  # guarded-by: _lock
         # Pre-fsync fault seam, mirroring FileWal.fault_hook.
         self.fault_hook = None
         # store/commit run from different pooled lanes (reference reqstore
@@ -388,7 +394,7 @@ class FileRequestStore:
             + ack.digest
         )
 
-    def _replay(self) -> None:
+    def _replay(self) -> None:  # holds: _lock
         try:
             with open(self._log_path, "rb") as f:
                 data = f.read()
@@ -411,7 +417,7 @@ class FileRequestStore:
             elif op == _OP_COMMIT:
                 self._index.pop(self._key(ack), None)
 
-    def _compact(self) -> None:
+    def _compact(self) -> None:  # holds: _lock
         tmp = self._log_path + ".tmp"
         with open(tmp, "wb") as f:
             for ack, data in self._index.values():
@@ -463,9 +469,15 @@ class FileRequestStore:
 
     def uncommitted(self, for_each) -> None:
         """Invoke for_each(ack) for every stored-but-uncommitted request, in
-        deterministic key order."""
-        for key in sorted(self._index):
-            for_each(self._index[key][0])
+        deterministic key order.
+
+        Snapshots under the lock, then calls back outside it: replay
+        callbacks re-enter the store (propose paths store/commit), and
+        holding _lock across them would deadlock."""
+        with self._lock:
+            acks = [self._index[key][0] for key in sorted(self._index)]
+        for ack in acks:
+            for_each(ack)
 
     def sync_token(self) -> int:
         """Group-commit ticket, mirroring FileWal.sync_token."""
@@ -481,7 +493,8 @@ class FileRequestStore:
             self._group.stop(flush=False)
         else:
             self._group.stop(flush=True)
-        self._file.close()
+        with self._lock:
+            self._file.close()
 
     def crash(self) -> None:
         """Crash-kill teardown: release the handle without the orderly
@@ -489,4 +502,5 @@ class FileRequestStore:
         page cache, but the skipped fsync still distinguishes the crash
         path from clean shutdown for the durable-prefix audit."""
         self._group.stop(flush=False)
-        self._file.close()
+        with self._lock:
+            self._file.close()

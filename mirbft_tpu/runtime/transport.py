@@ -167,30 +167,30 @@ class _PeerChannel:
     """Outbound lane to one peer: a bounded frame queue plus the sender
     thread that owns connecting, retrying, and draining it."""
 
-    def __init__(self, transport: "TcpTransport", peer_id: int):
+    def __init__(self, transport: "TcpTransport", peer_id: int):  # holds: _lock
         self.transport = transport
         self.peer_id = peer_id
         # Without latency emulation the deque holds bare frames; with a
         # LinkLatency installed it holds (due_monotonic, frame) pairs and
         # the sender drains only frames whose due time has passed.
-        self.queue: collections.deque = collections.deque()
+        self.queue: collections.deque = collections.deque()  # guarded-by: cv
         self.latency: LinkLatency | None = transport._link_latency.get(
             peer_id
-        )
+        )  # guarded-by: cv
         self.cv = threading.Condition()
-        self.closed = False
-        self._drain_deadline = 0.0
+        self.closed = False  # guarded-by: cv
+        self._drain_deadline = 0.0  # guarded-by: cv
         self.backoff = Backoff(
             base=transport.backoff_base, cap=transport.backoff_cap
         )
         # Drop/retry accounting (read via TcpTransport.counters()).
-        self.enqueued = 0
-        self.sent = 0
-        self.dropped_overflow = 0
-        self.dropped_closed = 0
-        self.send_failures = 0
-        self.connect_failures = 0
-        self.connects = 0
+        self.enqueued = 0  # guarded-by: cv
+        self.sent = 0  # guarded-by: cv
+        self.dropped_overflow = 0  # guarded-by: cv
+        self.dropped_closed = 0  # guarded-by: cv
+        self.send_failures = 0  # guarded-by: cv
+        self.connect_failures = 0  # guarded-by: cv
+        self.connects = 0  # guarded-by: cv
         self.thread = threading.Thread(
             target=self._run,
             name=f"tcp-send-{transport.node_id}-{peer_id}",
@@ -295,7 +295,8 @@ class _PeerChannel:
                 with send_lock:
                     conn.sendall(buf)
             except OSError:
-                self.send_failures += 1
+                with self.cv:
+                    self.send_failures += 1
                 _frame_outcome("send_failure")
                 self._drop_conn(entry)
                 # Put the burst back at the head, oldest first, so
@@ -334,17 +335,19 @@ class _PeerChannel:
                 address = transport._peers.get(self.peer_id)
             if entry is not None:
                 return entry
-            closing = transport._closed.is_set() or self.closed
+            with self.cv:
+                chan_closed = self.closed
+            closing = transport._closed.is_set() or chan_closed
             if closing or address is None:
                 # No new connections once closing; draining only flushes
                 # over connections that already exist.
                 return None
             fault = transport.fault
             if fault is not None and not fault.on_dial(self.peer_id):
-                self.connect_failures += 1
                 _dial_outcome("faulted")
                 delay = self.backoff.next()
                 with self.cv:
+                    self.connect_failures += 1
                     if not self.closed:
                         self.cv.wait(timeout=delay)
                 continue
@@ -356,18 +359,18 @@ class _PeerChannel:
                 # Dial deadline: a peer that accepts SYNs but never
                 # completes (or a black-holing firewall) cannot pin the
                 # sender thread longer than dial_timeout per attempt.
-                self.connect_failures += 1
                 _dial_outcome("timeout")
                 delay = self.backoff.next()
                 with self.cv:
+                    self.connect_failures += 1
                     if not self.closed:
                         self.cv.wait(timeout=delay)
                 continue
             except OSError:
-                self.connect_failures += 1
                 _dial_outcome("failed")
                 delay = self.backoff.next()
                 with self.cv:
+                    self.connect_failures += 1
                     if not self.closed:
                         self.cv.wait(timeout=delay)
                 continue
@@ -383,7 +386,8 @@ class _PeerChannel:
                 conn.close()
                 entry = existing
             else:
-                self.connects += 1
+                with self.cv:
+                    self.connects += 1
                 _dial_outcome("connected")
                 # First frame on a fresh connection: the clock-sync
                 # hello (monotonic anchor for trace alignment).  Best
@@ -429,35 +433,35 @@ class TcpTransport:
         self.fault: TransportFault | None = None
         # peer id -> LinkLatency for emulated WAN links (see
         # set_link_latency); empty in production.
-        self._link_latency: dict[int, LinkLatency] = {}
+        self._link_latency: dict[int, LinkLatency] = {}  # guarded-by: _lock
         # Frame-encoder scratch: per-thread bytearray (multiple processor
         # stage threads may send concurrently) plus the precomputed source
         # id varint every outbound frame starts with.
         self._scratch = threading.local()
         self._src_prefix = wire.encode_varint(node_id)
         self._node = None
-        self._peers: dict[int, tuple] = {}  # id -> (host, port)
+        self._peers: dict[int, tuple] = {}  # guarded-by: _lock
         # id -> (socket, per-connection send lock).  The transport-wide
         # _lock guards only the maps; each peer's sends run on its own
         # sender thread so one stalled peer cannot block the others.
-        self._conns: dict[int, tuple[socket.socket, threading.Lock]] = {}
-        self._channels: dict[int, _PeerChannel] = {}
+        self._conns: dict[int, tuple[socket.socket, threading.Lock]] = {}  # guarded-by: _lock
+        self._channels: dict[int, _PeerChannel] = {}  # guarded-by: _lock
         # Sends to peers never registered via connect(): dropped, counted.
-        self.dropped_unknown = 0
+        self.dropped_unknown = 0  # guarded-by: _lock
         # Frames suppressed by the fault seam (chaos runs only).
-        self.dropped_fault = 0
+        self.dropped_fault = 0  # guarded-by: _lock
         # peer id -> (local perf_counter_ns - peer perf_counter_ns),
         # estimated from the clock-sync hello on each inbound connection.
-        self._clock_offsets: dict[int, int] = {}
+        self._clock_offsets: dict[int, int] = {}  # guarded-by: _lock
         # Accepted inbound sockets.  close() must shutdown+close these too:
         # leaving them open keeps their read threads blocked in recv, keeps
         # the port occupied past a rebind, and — worse — lets a "closed"
         # transport keep delivering frames to its sink.
-        self._accepted: set[socket.socket] = set()
+        self._accepted: set[socket.socket] = set()  # guarded-by: _lock
         # Reader threads for accepted sockets, tracked so close() can join
         # them: a daemon thread parked in recv survives close() otherwise,
         # and 100 start/stop cycles then leak 100 threads.
-        self._read_threads: set[threading.Thread] = set()
+        self._read_threads: set[threading.Thread] = set()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._closed = threading.Event()
 
@@ -551,12 +555,14 @@ class TcpTransport:
         frame = self._encode_frame(msg)
         fault = self.fault
         if fault is not None and not fault.on_send(dest, frame):
-            self.dropped_fault += 1
+            with self._lock:
+                self.dropped_fault += 1
             _frame_outcome("dropped_fault")
             return  # injected loss: indistinguishable from the network's
         channel = self._channel(dest)
         if channel is None:
-            self.dropped_unknown += 1
+            with self._lock:
+                self.dropped_unknown += 1
             _frame_outcome("dropped_unknown")
             return  # unknown peer: dropped, like any unreachable host
         channel.enqueue(frame)
@@ -578,7 +584,8 @@ class TcpTransport:
         frame = _LEN.pack(len(payload)) + payload
         channel = self._channel(dest)
         if channel is None:
-            self.dropped_unknown += 1
+            with self._lock:
+                self.dropped_unknown += 1
             _frame_outcome("dropped_unknown")
             return
         channel.enqueue(frame)
@@ -589,6 +596,8 @@ class TcpTransport:
         with self._lock:
             channels = dict(self._channels)
             connected = set(self._conns)
+            dropped_unknown = self.dropped_unknown
+            dropped_fault = self.dropped_fault
         peers = {}
         for peer_id, ch in channels.items():
             with ch.cv:
@@ -604,8 +613,8 @@ class TcpTransport:
                     "connects": ch.connects,
                 }
         return {
-            "dropped_unknown": self.dropped_unknown,
-            "dropped_fault": self.dropped_fault,
+            "dropped_unknown": dropped_unknown,
+            "dropped_fault": dropped_fault,
             "peers": peers,
         }
 

@@ -281,6 +281,8 @@ class AsyncSignaturePlane(SignaturePlane):
         launch_fn=None,
         breaker=None,
         timeout_s=None,
+        max_outstanding: int = 8,
+        stale_boundaries: int = 2,
     ):
         # Default chunk/sublanes: 1024-row launches on the 8x128 tile.
         # A monolithic wave would make the FIRST forced readback wait for
@@ -312,13 +314,28 @@ class AsyncSignaturePlane(SignaturePlane):
         # Pallas default needs a real TPU).
         self._launch_fn = launch_fn
         self._wave: list = []  # [(key, marshal_light row, pk, msg, sig)]
-        # cid -> (wave entries, out, launch_s); the full entries (not just
-        # keys) are retained so a failed readback can host-rescue from the
-        # (pk, msg, sig) material without re-marshalling.
+        # cid -> (wave entries, out, launch_s, born_boundary); the full
+        # entries (not just keys) are retained so a failed readback can
+        # host-rescue from the (pk, msg, sig) material without
+        # re-marshalling.
         self._chunks: dict = {}
         self._chunk_of: dict = {}  # key -> cid
         self._next_chunk = 0
         self._dirty = False
+        # Bounded-outstanding discipline: under manglers a request can be
+        # submitted (and its chunk launched) yet never demanded — drops,
+        # redirects, and crashed recipients mean valid() never fires for
+        # its key, so without retirement _chunks/_chunk_of grow for the
+        # whole run.  Two bounds keep them finite:
+        #   - max_outstanding caps live chunks; launching past the cap
+        #     forces the oldest chunk's readback first.
+        #   - a chunk still undemanded stale_boundaries wave boundaries
+        #     after launch is force-read at on_time (its kernel finished
+        #     long ago, so the readback is a near-free drain).
+        self.max_outstanding = max_outstanding
+        self.stale_boundaries = stale_boundaries
+        self._boundary = 0  # on_time wave-boundary counter
+        self.forced_retirements = 0
         # Telemetry (bench): launches overlapped with the event loop,
         # device/host verdict split, demanded-before-ready blocks.
         self.overlapped_launches = 0
@@ -351,6 +368,20 @@ class AsyncSignaturePlane(SignaturePlane):
             self._launch()
 
     def on_time(self, _now: int) -> None:
+        self._boundary += 1
+        # Force-or-free stale chunks: anything launched stale_boundaries
+        # wave boundaries ago and still undemanded gets its verdicts read
+        # back now, freeing the retained wave material and the _chunk_of
+        # index entries (the verdict cache itself is the plane's contract).
+        floor = self._boundary - self.stale_boundaries
+        stale = [
+            cid
+            for cid, entry in self._chunks.items()
+            if entry[3] <= floor
+        ]
+        for cid in stale:
+            self.forced_retirements += 1
+            self._retire(cid)
         if self._dirty:
             self._dirty = False
             if len(self._wave) >= self.min_device_rows:
@@ -383,9 +414,16 @@ class AsyncSignaturePlane(SignaturePlane):
         launch_s = time.perf_counter() - start
         cid = self._next_chunk
         self._next_chunk += 1
-        self._chunks[cid] = (wave, out, launch_s)
+        self._chunks[cid] = (wave, out, launch_s, self._boundary)
         for k, _row, _pk, _m, _s in wave:
             self._chunk_of[k] = cid
+        # Cap outstanding chunks: retire the oldest (its kernel queued
+        # first, so it is the most likely to be done) before the map can
+        # outgrow max_outstanding.
+        while len(self._chunks) > self.max_outstanding:
+            oldest = min(self._chunks)
+            self.forced_retirements += 1
+            self._retire(oldest)
         self.flush_sizes.append(len(wave))
         self.overlapped_launches += 1
         self.device_verifies += len(wave)
@@ -406,11 +444,16 @@ class AsyncSignaturePlane(SignaturePlane):
         return self._force(cid, key)
 
     def _force(self, cid: int, key) -> bool:
+        self._retire(cid)
+        return self._verdicts[key]
+
+    def _retire(self, cid: int) -> None:
+        """Read a chunk's verdicts back and drop its retained material."""
         import time
 
         import numpy as np
 
-        wave, out, launch_s = self._chunks.pop(cid)
+        wave, out, launch_s, _born = self._chunks.pop(cid)
         start = time.perf_counter()
         try:
             valid = np.asarray(out)
@@ -433,7 +476,7 @@ class AsyncSignaturePlane(SignaturePlane):
             self.flush_wall_s.append(wall)
             if hooks.enabled:
                 hooks.record_flush("signature", "rescued", len(wave), wall)
-            return self._verdicts[key]
+            return
         self.breaker.record_success()
         wall = launch_s + time.perf_counter() - start
         self.flush_wall_s.append(wall)
@@ -444,7 +487,6 @@ class AsyncSignaturePlane(SignaturePlane):
         for i, (k, _row, _pk, _m, _s) in enumerate(wave):
             verdicts[k] = bool(valid[i])
             del chunk_of[k]
-        return verdicts[key]
 
     def _host_verify_wave(self, wave: list) -> None:
         """Synchronously judge a wave's entries via the host oracle."""

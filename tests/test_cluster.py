@@ -95,6 +95,125 @@ def _wait_for(predicate, timeout_s, what):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+def test_supervisor_submit_teardown_race_is_a_clean_error(tmp_path):
+    """Regression for the submit/teardown TOCTOU (flagged by the C201
+    guarded-by checker): submit() runs on load-generator threads while
+    teardown() closes and None-s the client transport on the driver
+    thread.  The handle must be snapshotted under the supervisor lock, so
+    a loser of the race sees RuntimeError (or a harmless propose into a
+    closing transport) — never an AttributeError off a None handle."""
+    import threading
+
+    sup = ClusterSupervisor(
+        node_count=2, client_ids=[1], root=str(tmp_path / "cluster")
+    )
+    request = pb.Request(client_id=1, req_no=0, data=b"race")
+    # Unstarted: the clean error, not AttributeError.
+    with pytest.raises(RuntimeError):
+        sup.submit(0, request)
+
+    class _StubTransport:
+        def propose(self, node_id, req):
+            pass
+
+        def close(self, node_id):
+            pass
+
+    errors = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                sup.submit(0, request)
+            except RuntimeError:
+                pass
+            except BaseException as exc:  # AttributeError == the old bug
+                errors.append(exc)
+                return
+
+    worker = threading.Thread(target=hammer)
+    worker.start()
+    try:
+        for _ in range(300):
+            with sup._lock:
+                sup._client_transport = _StubTransport()
+            sup.teardown()  # closes + None-s the handle, no nodes to stop
+    finally:
+        stop.set()
+        worker.join(timeout=10.0)
+    assert not errors, errors
+    with pytest.raises(RuntimeError):
+        sup.submit(0, request)
+
+
+def test_cluster_lock_acquisition_graph_is_acyclic(tmp_path, monkeypatch):
+    """Dynamic lock-order harness (docs/ANALYSIS.md): submit threads
+    drive the supervisor's client TcpTransport (reconnect backoff
+    against a dead peer included) while the driver thread tears down,
+    with every threading primitive in the supervisor and transport
+    instrumented; the cross-thread lock graph must stay cycle-free."""
+    import socket
+    import sys
+    import threading
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "tools")
+    )
+    from analysis.lockorder import LockMonitor, _InstrumentedLock
+
+    from mirbft_tpu.cluster import supervisor as supervisor_mod
+    from mirbft_tpu.runtime import transport as transport_mod
+
+    monitor = LockMonitor()
+    proxy = monitor.threading_proxy()
+    monkeypatch.setattr(supervisor_mod, "threading", proxy)
+    monkeypatch.setattr(transport_mod, "threading", proxy)
+
+    sup = ClusterSupervisor(
+        node_count=2, client_ids=[1], root=str(tmp_path / "cluster")
+    )
+    assert isinstance(sup._lock, _InstrumentedLock)
+    client = transport_mod.TcpTransport(
+        supervisor_mod._CLIENT_NODE_ID,
+        port=0,
+        backoff_base=0.01,
+        backoff_cap=0.05,
+        dial_timeout=0.2,
+    )
+    assert isinstance(client._lock, _InstrumentedLock)
+    # A bound-but-not-listening port refuses connections deterministically,
+    # so sends exercise the channel cv's reconnect-backoff waits.
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    try:
+        client.connect(0, dead.getsockname())
+        with sup._lock:
+            sup._client_transport = client
+        request = pb.Request(client_id=1, req_no=0, data=b"lockorder")
+
+        def hammer():
+            for _ in range(50):
+                try:
+                    sup.submit(0, request)
+                except RuntimeError:
+                    return
+                time.sleep(0.001)
+
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        sup.teardown()
+        for t in threads:
+            t.join(timeout=10.0)
+    finally:
+        dead.close()
+        client.close(0)
+    monitor.assert_no_cycles()
+
+
 @pytest.mark.slow
 def test_supervisor_boot_commit_kill_restart_teardown(tmp_path):
     sup = ClusterSupervisor(

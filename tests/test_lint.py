@@ -332,3 +332,493 @@ def test_linter_confines_process_management_to_cluster(tmp_path):
     tests_ok.parent.mkdir(parents=True)
     tests_ok.write_text("import subprocess\nx = subprocess\n")
     assert not any("W11" in line for line in lint.check_file(tests_ok))
+
+
+# ---------------------------------------------------------------------------
+# rule engine (tools/analysis/engine.py)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_json_runs_the_full_suite_repo_wide():
+    """Acceptance gate: ``python tools/lint.py --json`` runs the W+D+C
+    suite over every source tree and exits 0 with zero findings."""
+    import json
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), "--json"],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1
+    assert doc["total"] == 0, doc["findings"]
+    assert doc["findings"] == []
+
+
+def test_rule_ids_unique_and_documented():
+    """Every registered rule id is unique (the registry enforces it at
+    import), carries a title and doc, and appears in docs/ANALYSIS.md."""
+    from analysis.engine import all_rules
+
+    rules = all_rules()
+    ids = [rule.id for rule in rules]
+    assert len(ids) == len(set(ids)), ids
+    doc = (REPO / "docs" / "ANALYSIS.md").read_text()
+    for rule in rules:
+        assert rule.title and rule.doc, f"{rule.id} lacks title/doc"
+        assert rule.id in doc, f"{rule.id} undocumented in docs/ANALYSIS.md"
+
+
+def test_suppression_honored_only_with_reason(tmp_path):
+    """A reasoned same-line suppression drops the finding; a reason-less
+    one keeps it AND emits S1 ('a suppression without a reason is a
+    finding').  S1 itself cannot be suppressed away."""
+    import analysis.engine as engine
+
+    reasoned = tmp_path / "reasoned.py"
+    reasoned.write_text(
+        "x = 1\n"
+        "y = x is 'nope'  # lint: allow W4 exercising the identity check\n"
+    )
+    assert engine.run([reasoned]).findings == []
+
+    bare = tmp_path / "bare.py"
+    bare.write_text("x = 1\ny = x is 'nope'  # lint: allow W4\n")
+    codes = {f.rule for f in engine.run([bare]).findings}
+    assert codes == {"W4", "S1"}, codes
+
+    meta = tmp_path / "meta.py"
+    meta.write_text("pass  # lint: allow S1\n")
+    codes = {f.rule for f in engine.run([meta]).findings}
+    assert codes == {"S1"}, codes
+
+
+def test_baseline_masks_old_findings_not_new_ones(tmp_path):
+    """The committed baseline lets a new rule land strict: pre-existing
+    findings are masked (by line-number-free key, so unrelated edits
+    don't churn it) while anything new stays red."""
+    import analysis.engine as engine
+
+    f = tmp_path / "old.py"
+    f.write_text("def f(a=[]):\n    return a\n")
+    first = engine.run([f], repo_root=tmp_path)
+    assert {x.rule for x in first.findings} == {"W5"}
+    doc = engine.dump_baseline(first.findings, tmp_path)
+    baseline = {e["key"]: e["count"] for e in doc["findings"]}
+
+    masked = engine.run([f], repo_root=tmp_path, baseline=baseline)
+    assert masked.findings == [] and masked.baselined == 1
+
+    # A new instance of the same defect class is NOT covered.
+    f.write_text("def f(a=[]):\n    return a\n\n\ndef g(b=[]):\n    return b\n")
+    again = engine.run([f], repo_root=tmp_path, baseline=baseline)
+    assert again.baselined == 1
+    assert len(again.findings) == 1 and again.findings[0].rule == "W5"
+    assert again.findings[0].line == 5
+
+
+def test_json_schema_round_trips(tmp_path):
+    import json
+
+    import analysis.engine as engine
+
+    f = tmp_path / "bad.py"
+    f.write_text("import os\nx = 1\ny = x is 'nope'\n")
+    res = engine.run([f], repo_root=tmp_path)
+    assert res.findings, "fixture should produce findings"
+    doc = json.loads(json.dumps(engine.to_json(res, tmp_path)))
+    back = engine.from_json(doc)
+    assert [(x.rule, x.line, x.message) for x in back.findings] == [
+        (x.rule, x.line, x.message) for x in res.findings
+    ]
+    assert doc["total"] == len(res.findings)
+    assert sum(doc["counts"].values()) == doc["total"]
+    try:
+        engine.from_json({"version": 99, "findings": []})
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("unsupported schema version must be rejected")
+
+
+def test_committed_baseline_is_empty():
+    """The repo swept clean under the full suite: the baseline ships
+    empty and must only ever shrink (docs/ANALYSIS.md)."""
+    import json
+
+    doc = json.loads(
+        (REPO / "tools" / "analysis" / "baseline.json").read_text()
+    )
+    assert doc["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# W12: unseeded randomness
+# ---------------------------------------------------------------------------
+
+
+def test_linter_bans_unseeded_global_randomness(tmp_path):
+    """W12: random.* module-global functions and numpy.random legacy
+    state are banned inside mirbft_tpu/ — fault schedules, manglers, and
+    jitter must replay from explicit seeds."""
+    import lint
+
+    bad = tmp_path / "mirbft_tpu" / "chaos" / "sneaky.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import random\n"
+        "x = random.random()\n"
+        "random.seed(7)\n"
+        "from random import randint\n"
+    )
+    findings = [line for line in lint.check_file(bad) if "W12" in line]
+    assert len(findings) == 3, findings
+
+    legacy = tmp_path / "mirbft_tpu" / "ops" / "sneaky2.py"
+    legacy.parent.mkdir(parents=True)
+    legacy.write_text(
+        "import numpy as np\n"
+        "y = np.random.rand(3)\n"
+        "import numpy.random\n"
+        "from numpy.random import default_rng\n"
+    )
+    findings = [line for line in lint.check_file(legacy) if "W12" in line]
+    assert len(findings) == 3, findings
+
+    seeded = tmp_path / "mirbft_tpu" / "chaos" / "fine.py"
+    seeded.write_text(
+        "import random\nrng = random.Random(7)\nx = rng.random()\n"
+    )
+    assert not any("W12" in line for line in lint.check_file(seeded))
+
+    # Tests, tools, and bench may use ambient randomness freely.
+    outside = tmp_path / "tests" / "test_whatever.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("import random\nx = random.random()\n")
+    assert not any("W12" in line for line in lint.check_file(outside))
+
+
+# ---------------------------------------------------------------------------
+# D1xx: determinism purity auditor
+# ---------------------------------------------------------------------------
+
+
+def _package(tmp_path, files):
+    """Materialize a synthetic mirbft_tpu package and return its root."""
+    root = tmp_path / "mirbft_tpu"
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    for d in root.rglob("*"):
+        if d.is_dir() and not (d / "__init__.py").exists():
+            (d / "__init__.py").write_text("")
+    if not (root / "__init__.py").exists():
+        (root / "__init__.py").write_text("")
+    return root
+
+
+def _d_findings(root):
+    import analysis.engine as engine
+
+    res = engine.run([root])
+    return [f for f in res.findings if f.rule.startswith("D")]
+
+
+def test_purity_auditor_flags_impure_import_in_core(tmp_path):
+    root = _package(
+        tmp_path, {"core/evil.py": "import threading\nx = threading\n"}
+    )
+    found = _d_findings(root)
+    assert any(
+        f.rule == "D101" and "threading" in f.message for f in found
+    ), found
+
+
+def test_purity_auditor_follows_transitive_imports(tmp_path):
+    """core/ must stay pure through every module it reaches, not just its
+    own imports: core -> util -> socket is a finding, with the chain."""
+    root = _package(
+        tmp_path,
+        {
+            "core/a.py": "from ..util import helper\nx = helper\n",
+            "util.py": "import socket\n\n\ndef helper():\n    return socket\n",
+        },
+    )
+    found = _d_findings(root)
+    chained = [
+        f
+        for f in found
+        if f.rule == "D101" and "socket" in f.message and "via" in f.message
+    ]
+    assert chained, found
+
+
+def test_purity_auditor_flags_direct_effects(tmp_path):
+    root = _package(
+        tmp_path,
+        {
+            "core/fx.py": (
+                "def load(p):\n"
+                "    return open(p).read()\n"
+                "\n"
+                "\n"
+                "def tag(x):\n"
+                "    return id(x)\n"
+            ),
+        },
+    )
+    rules = {f.rule for f in _d_findings(root)}
+    assert "D102" in rules and "D103" in rules, rules
+
+
+def test_purity_auditor_catches_set_iteration_ordering(tmp_path):
+    """D104 regression for the epoch_tracker defect this suite caught:
+    iterating a set into ordered protocol state is trace-visible
+    nondeterminism; sorted(set(...)) is the sanctioned spelling."""
+    root = _package(
+        tmp_path,
+        {
+            "core/scan.py": (
+                "def scan(d):\n"
+                "    out = []\n"
+                "    for v in set(d.values()):\n"
+                "        out.append(v)\n"
+                "    return out\n"
+            ),
+        },
+    )
+    found = _d_findings(root)
+    assert any(f.rule == "D104" for f in found), found
+
+    fixed = _package(
+        tmp_path / "fixed",
+        {
+            "core/scan.py": (
+                "def scan(d):\n"
+                "    out = []\n"
+                "    for v in sorted(set(d.values())):\n"
+                "        out.append(v)\n"
+                "    return out\n"
+            ),
+        },
+    )
+    assert not _d_findings(fixed)
+
+
+def test_purity_auditor_ignores_modules_outside_the_roots(tmp_path):
+    """Impure imports in non-root, non-reached modules are fine — the
+    auditor proves the purity roots' transitive closure, nothing more."""
+    root = _package(
+        tmp_path,
+        {
+            "core/pure.py": "X = 1\n",
+            "runtime/io_stuff.py": "import socket\nx = socket\n",
+        },
+    )
+    assert not _d_findings(root)
+
+
+# ---------------------------------------------------------------------------
+# C2xx: guarded-by checker
+# ---------------------------------------------------------------------------
+
+
+def _c_findings(tmp_path, src, name="guarded.py"):
+    import lint
+
+    f = tmp_path / name
+    f.write_text(src)
+    return [line for line in lint.check_file(f) if " C2" in line]
+
+
+def test_guarded_by_checker_flags_unlocked_access(tmp_path):
+    found = _c_findings(
+        tmp_path,
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "        self.items = 0  # guarded-by: _lock\n"
+        "\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            self.items += 1\n"
+        "\n"
+        "    def good_cv(self):\n"
+        "        with self._cv:\n"
+        "            return self.items\n"
+        "\n"
+        "    def bad(self):\n"
+        "        return self.items\n",
+    )
+    assert len(found) == 1 and "C201" in found[0], found
+    assert ":19:" in found[0], found  # bad()'s read, not the guarded ones
+
+
+def test_guarded_by_checker_init_is_exempt(tmp_path):
+    found = _c_findings(
+        tmp_path,
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = 0  # guarded-by: _lock\n"
+        "        self.items += 1\n",
+    )
+    assert found == [], found
+
+
+def test_guarded_by_checker_nested_defs_do_not_inherit_with(tmp_path):
+    """A closure runs later on an arbitrary thread: the enclosing with
+    does not protect its body."""
+    found = _c_findings(
+        tmp_path,
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = 0  # guarded-by: _lock\n"
+        "\n"
+        "    def handed_off(self):\n"
+        "        with self._lock:\n"
+        "            def cb():\n"
+        "                return self.items\n"
+        "            return cb\n",
+    )
+    assert len(found) == 1 and "C201" in found[0], found
+
+
+def test_holds_annotation_checks_call_sites(tmp_path):
+    found = _c_findings(
+        tmp_path,
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = 0  # guarded-by: _lock\n"
+        "\n"
+        "    def _bump(self):  # holds: _lock\n"
+        "        self.items += 1\n"
+        "\n"
+        "    def calls_held(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "\n"
+        "    def calls_bare(self):\n"
+        "        self._bump()\n",
+    )
+    assert len(found) == 1 and "C202" in found[0], found
+    assert ":17:" in found[0], found
+
+
+def test_guarded_by_unknown_lock_is_flagged(tmp_path):
+    found = _c_findings(
+        tmp_path,
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self.items = 0  # guarded-by: _mutex\n",
+    )
+    assert len(found) == 1 and "C203" in found[0], found
+
+
+# ---------------------------------------------------------------------------
+# lock-order harness (tools/analysis/lockorder.py)
+# ---------------------------------------------------------------------------
+
+
+def test_lock_monitor_passes_consistent_order():
+    from analysis.lockorder import LockMonitor
+
+    mon = LockMonitor()
+    a = mon.Lock()
+    b = mon.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    mon.assert_no_cycles()
+
+
+def test_lock_monitor_detects_order_inversion():
+    import threading
+
+    import pytest
+
+    from analysis.lockorder import LockMonitor, LockOrderViolation
+
+    mon = LockMonitor()
+    a = mon.Lock()
+    b = mon.Lock()
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    with pytest.raises(LockOrderViolation):
+        mon.assert_no_cycles()
+
+
+def test_lock_monitor_condition_wait_is_not_an_inversion():
+    """Condition.wait releases and reacquires its lock; the reacquire
+    must not be recorded as acquiring under whatever the waiter's peers
+    held meanwhile."""
+    import threading
+
+    from analysis.lockorder import LockMonitor
+
+    mon = LockMonitor()
+    lock = mon.Lock()
+    cv = mon.Condition(lock)
+    other = mon.Lock()
+    done = []
+
+    def waiter():
+        with cv:
+            cv.wait_for(lambda: done, timeout=5.0)
+
+    def kicker():
+        with other:
+            with cv:
+                done.append(1)
+                cv.notify_all()
+
+    t1 = threading.Thread(target=waiter)
+    t1.start()
+    t2 = threading.Thread(target=kicker)
+    t2.start()
+    t1.join()
+    t2.join()
+    mon.assert_no_cycles()
+
+
+def test_lock_monitor_threading_proxy_forwards():
+    import threading
+
+    from analysis.lockorder import LockMonitor, _InstrumentedLock
+
+    mon = LockMonitor()
+    proxy = mon.threading_proxy()
+    assert isinstance(proxy.Lock(), _InstrumentedLock)
+    event = proxy.Event()
+    assert isinstance(event, threading.Event)
+    assert proxy.current_thread() is threading.current_thread()

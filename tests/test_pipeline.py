@@ -360,3 +360,47 @@ def test_closed_processor_rejects_new_batches(tmp_path):
         proc.process(_persist_send_actions(1))
     store.close()
     wal.close()
+
+
+def test_pipeline_lock_acquisition_graph_is_acyclic(tmp_path, monkeypatch):
+    """Dynamic lock-order harness (docs/ANALYSIS.md): run real batches
+    through the pipelined processor and the group-commit stores with
+    every threading primitive instrumented; the cross-thread
+    (held-lock, acquired-lock) graph must stay cycle-free — a cycle is
+    a potential deadlock even if this run never interleaved into it."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "tools")
+    )
+    from analysis.lockorder import LockMonitor, _InstrumentedLock
+
+    from mirbft_tpu.runtime import processor as processor_mod
+    from mirbft_tpu.runtime import storage as storage_mod
+
+    monitor = LockMonitor()
+    proxy = monitor.threading_proxy()
+    monkeypatch.setattr(processor_mod, "threading", proxy)
+    monkeypatch.setattr(storage_mod, "threading", proxy)
+
+    node, link, wal, store, proc = _build(tmp_path)
+    # The wiring is real: the primitives under test are instrumented.
+    assert isinstance(proc._mutex, _InstrumentedLock)
+    assert isinstance(wal._lock, _InstrumentedLock)
+    assert isinstance(store._lock, _InstrumentedLock)
+    try:
+        for i in range(1, 6):
+            proc.process(_persist_send_actions(i))
+        actions = act.Actions()
+        actions.hash([b"preimage"], None)
+        proc.process(actions)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(link.sent) < 5:
+            time.sleep(0.005)
+        assert len(link.sent) == 5, link.sent
+    finally:
+        proc.close()
+        store.close()
+        wal.close()
+    monitor.assert_no_cycles()

@@ -236,6 +236,40 @@ def test_async_signature_plane_readback_failure_host_rescues():
     assert plane.fallback_verifies == 3
 
 
+def test_async_signature_plane_undemanded_chunks_stay_bounded():
+    """Regression for the chunk leak: under manglers a submitted request
+    may never be demanded (drops, redirects, crashed recipients), and
+    launched chunks used to pin their wave material in _chunks/_chunk_of
+    for the whole run.  Stale chunks must now retire at wave boundaries
+    and the outstanding-chunk cap must hold over a long faulted run."""
+    import numpy as np
+
+    plane = AsyncSignaturePlane(
+        chunk=4,
+        min_device_rows=1,
+        max_outstanding=3,
+        stale_boundaries=2,
+        launch_fn=lambda rows, sublanes: np.ones(len(rows), dtype=bool),
+    )
+    signer = make_signer()
+    first = signer(7, 0, b"payload0")
+    req_no = 0
+    for boundary in range(30):
+        for _ in range(4):  # one full chunk per boundary, never demanded
+            plane.submit(7, req_no, signer(7, req_no, b"payload%d" % req_no))
+            req_no += 1
+        plane.on_time(boundary)
+        assert len(plane._chunks) <= plane.max_outstanding
+        assert len(plane._chunk_of) <= plane.max_outstanding * plane.chunk
+    assert plane.forced_retirements > 0
+    # Retired chunks resolved into real verdicts: only the most recent
+    # (still legitimately in flight) chunks may remain pending.
+    pending = sum(1 for v in plane._verdicts.values() if v is None)
+    assert pending <= plane.max_outstanding * plane.chunk
+    # A retired-without-demand verdict is still served from the cache.
+    assert plane.valid(7, 0, first) is True
+
+
 # ---------------------------------------------------------------------------
 # status.py snapshots
 # ---------------------------------------------------------------------------

@@ -1,0 +1,36 @@
+"""mirbft-tpu static-analysis suite.
+
+The reference CI runs staticcheck + the Go race detector on every build
+(reference: .travis.yml:16-18).  This package is that discipline rebuilt
+for the Python port, stdlib-only, in three layers:
+
+- ``engine``   — the rule registry, per-line suppressions, the committed
+  baseline, and machine-readable (``--json``) output.
+- ``rules_w``  — general defect classes (W1..W12): the original
+  tools/lint.py checks as Rule objects plus the seeded-randomness ban.
+- ``rules_d``  — the determinism purity auditor (D1xx): an import graph
+  over ``mirbft_tpu/`` proving that ``core/`` and the deterministic
+  testengine paths never transitively reach an impure effect (clocks,
+  unseeded randomness, I/O, threading, env, ``id()``, set iteration
+  feeding ordered state), modulo a documented allowlist.
+- ``rules_c``  — the concurrency checker (C2xx): the ``# guarded-by:``
+  annotation convention on shared attributes, statically enforced.
+- ``lockorder`` — the dynamic half of the race story: instrumented locks
+  recording the cross-thread acquisition graph and failing on order
+  cycles (the stand-in for ``go test -race``), wired into the
+  pipeline/transport/cluster tier-1 tests.
+
+``tools/lint.py`` remains the CLI entry point (a thin shim over this
+package).  Policy and the rule catalog live in docs/ANALYSIS.md.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    FileContext,
+    Rule,
+    REGISTRY,
+    all_rules,
+    load_baseline,
+    run,
+    to_json,
+)

@@ -1,0 +1,71 @@
+"""CLI for the analysis suite (invoked through the tools/lint.py shim).
+
+    python tools/lint.py [paths...]            human-readable findings
+    python tools/lint.py --json [paths...]     machine-readable (schema v1)
+    python tools/lint.py --update-baseline     accept current findings
+
+Exit status: 0 when no non-baselined findings, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+from .engine import dump_baseline, load_baseline, run, to_json
+
+REPO = Path(__file__).resolve().parent.parent.parent
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+DEFAULT_TARGETS = (
+    "mirbft_tpu",
+    "tests",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools/lint.py", description=__doc__
+    )
+    parser.add_argument("paths", nargs="*", type=Path)
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the machine-readable schema instead of text",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=BASELINE_PATH,
+        help="baseline file masking accepted findings",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    targets = args.paths or [REPO / t for t in DEFAULT_TARGETS]
+
+    if args.update_baseline:
+        result = run(targets, repo_root=REPO, baseline=None)
+        args.baseline.write_text(
+            json.dumps(dump_baseline(result.findings, REPO), indent=2) + "\n"
+        )
+        print(
+            f"lint: baseline updated with {len(result.findings)} finding(s)"
+        )
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    result = run(targets, repo_root=REPO, baseline=baseline)
+    if args.as_json:
+        print(json.dumps(to_json(result, REPO), indent=2))
+    else:
+        for line in result.render():
+            print(line)
+        print(f"lint: {len(result.findings)} finding(s)")
+        if result.baselined:
+            print(f"lint: {result.baselined} baselined finding(s) masked")
+    return 1 if result.findings else 0

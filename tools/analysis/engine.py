@@ -1,0 +1,334 @@
+"""Rule registry, suppressions, baseline, and runner.
+
+Execution model: every ``*.py`` file under the requested paths is parsed
+once into a :class:`FileContext`; per-file rules run over each context,
+project rules (the D1xx auditor needs the whole import graph) run once
+over the full context list.  Findings are then filtered through per-line
+suppressions and the committed baseline.
+
+Suppression convention (docs/ANALYSIS.md):
+
+    something_flagged()  # lint: allow W7 <reason>
+
+The reason is mandatory — a suppression without one is itself a finding
+(rule S1: "a suppression without a reason is a finding").  Multiple ids
+separate with commas: ``# lint: allow W7,C201 reason``.
+
+Baseline: a committed JSON file mapping finding keys (path::rule::message
+— deliberately line-number-free, so unrelated edits don't churn it) to
+counts.  ``run`` masks up to that many matching findings, letting a new
+rule land strict against new code without a big-bang cleanup; the gate
+stays red for anything the baseline does not cover.  ``--update-baseline``
+rewrites the file from the current findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*allow\s+([A-Z]+\d*(?:\s*,\s*[A-Z]+\d*)*)\s*(.*)"
+)
+
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: Path
+    line: int
+    message: str
+    severity: str = "error"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def key(self, repo_root: Path | None = None) -> str:
+        path = self.path
+        if repo_root is not None:
+            try:
+                path = path.resolve().relative_to(repo_root.resolve())
+            except ValueError:
+                pass
+        return f"{path.as_posix()}::{self.rule}::{self.message}"
+
+
+@dataclass
+class Rule:
+    """One registered check.
+
+    ``scope`` is a predicate over the file's resolved posix path (None =
+    every file); ``check`` takes a FileContext and yields Findings.
+    Rules with ``project=True`` instead receive the full list of
+    contexts, once — the D1xx auditor builds its import graph there.
+    """
+
+    id: str
+    title: str
+    doc: str
+    check: object  # callable; see class docstring
+    scope: object = None  # callable(posix: str) -> bool, or None
+    severity: str = "error"
+    project: bool = False
+
+
+class FileContext:
+    """One parsed source file, shared by every per-file rule."""
+
+    def __init__(self, path: Path):
+        self.path = path
+        self.posix = path.resolve().as_posix()
+        self.src = path.read_text()
+        self.lines = self.src.splitlines()
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree: ast.Module | None = ast.parse(
+                self.src, filename=str(path)
+            )
+        except SyntaxError as err:
+            self.tree = None
+            self.syntax_error = err
+        # line -> (set of rule ids allowed, reason)
+        self.suppressions: dict[int, tuple[set, str]] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            match = _SUPPRESS_RE.search(line)
+            if match is not None:
+                ids = {part.strip() for part in match.group(1).split(",")}
+                self.suppressions[lineno] = (ids, match.group(2).strip())
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.id in REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    REGISTRY[rule.id] = rule
+    return rule
+
+
+def all_rules() -> list[Rule]:
+    """Every registered rule, importing the rule modules on first use."""
+    import importlib
+
+    for mod in ("rules_c", "rules_d", "rules_w"):
+        importlib.import_module(f".{mod}", __package__)
+    return sorted(REGISTRY.values(), key=lambda r: r.id)
+
+
+register(
+    Rule(
+        id="S1",
+        title="suppression without a reason",
+        doc=(
+            "Every `# lint: allow <ID>` must carry a reason; a "
+            "suppression without a reason is a finding."
+        ),
+        check=None,  # enforced inline by run(); registered for the catalog
+    )
+)
+
+
+register(
+    Rule(
+        id="E0",
+        title="syntax error",
+        doc="The file does not parse; no other rule can run over it.",
+        check=None,  # enforced inline by run(); registered for the catalog
+    )
+)
+
+
+def _collect_contexts(paths: list[Path]) -> list[FileContext]:
+    contexts = []
+    for root in paths:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            contexts.append(FileContext(f))
+    return contexts
+
+
+def _apply_suppressions(
+    contexts: list[FileContext], findings: list[Finding]
+) -> list[Finding]:
+    """Drop findings covered by a reasoned same-line suppression; emit S1
+    findings for reason-less suppressions (and suppressions are never
+    allowed to silence S1 itself)."""
+    by_posix = {ctx.posix: ctx for ctx in contexts}
+    out = []
+    for finding in findings:
+        ctx = by_posix.get(finding.path.resolve().as_posix())
+        if ctx is not None:
+            supp = ctx.suppressions.get(finding.line)
+            if (
+                supp is not None
+                and finding.rule in supp[0]
+                and supp[1]
+                and finding.rule != "S1"
+            ):
+                continue
+        out.append(finding)
+    for ctx in contexts:
+        for lineno, (ids, reason) in sorted(ctx.suppressions.items()):
+            if not reason:
+                out.append(
+                    Finding(
+                        rule="S1",
+                        path=ctx.path,
+                        line=lineno,
+                        message=(
+                            f"suppression of {','.join(sorted(ids))} "
+                            "without a reason (a suppression without a "
+                            "reason is a finding)"
+                        ),
+                    )
+                )
+    return out
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)
+    baselined: int = 0
+
+    def render(self) -> list[str]:
+        return [f.render() for f in self.findings]
+
+
+def run(
+    paths: list[Path],
+    repo_root: Path | None = None,
+    baseline: dict[str, int] | None = None,
+) -> RunResult:
+    """Run every registered rule over ``paths``; returns surviving
+    findings (suppressions and baseline already applied) plus the count
+    of baseline-masked ones."""
+    rules = all_rules()
+    contexts = _collect_contexts(paths)
+    findings: list[Finding] = []
+    for ctx in contexts:
+        if ctx.syntax_error is not None:
+            findings.append(
+                Finding(
+                    rule="E0",
+                    path=ctx.path,
+                    line=ctx.syntax_error.lineno or 1,
+                    message=f"syntax error: {ctx.syntax_error.msg}",
+                )
+            )
+            continue
+        for rule in rules:
+            if rule.check is None or rule.project:
+                continue
+            if rule.scope is not None and not rule.scope(ctx.posix):
+                continue
+            findings.extend(rule.check(ctx))
+    parsed = [ctx for ctx in contexts if ctx.tree is not None]
+    for rule in rules:
+        if rule.check is None or not rule.project:
+            continue
+        findings.extend(rule.check(parsed))
+    findings = _apply_suppressions(contexts, findings)
+
+    result = RunResult()
+    remaining = dict(baseline or {})
+    for finding in findings:
+        key = finding.key(repo_root)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            result.baselined += 1
+        else:
+            result.findings.append(finding)
+    result.findings.sort(key=lambda f: (str(f.path), f.line, f.rule))
+    return result
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> dict[str, int]:
+    """Baseline file -> {finding key: masked count}.  Missing file = empty
+    baseline (the strict default)."""
+    try:
+        doc = json.loads(path.read_text())
+    except FileNotFoundError:
+        return {}
+    counts: dict[str, int] = {}
+    for entry in doc.get("findings", []):
+        counts[entry["key"]] = counts.get(entry["key"], 0) + int(
+            entry.get("count", 1)
+        )
+    return counts
+
+
+def dump_baseline(findings: list[Finding], repo_root: Path | None) -> dict:
+    counts: dict[str, int] = {}
+    for finding in findings:
+        key = finding.key(repo_root)
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "comment": (
+            "Accepted pre-existing findings, masked by tools/lint.py so "
+            "new rules land strict against new code.  Shrink this file; "
+            "never grow it (docs/ANALYSIS.md)."
+        ),
+        "findings": [
+            {"key": key, "count": count}
+            for key, count in sorted(counts.items())
+        ],
+    }
+
+
+# -- machine-readable output -------------------------------------------------
+
+
+def to_json(result: RunResult, repo_root: Path | None = None) -> dict:
+    """The ``--json`` schema (round-trip-tested in tests/test_lint.py)."""
+    counts: dict[str, int] = {}
+    for finding in result.findings:
+        counts[finding.rule] = counts.get(finding.rule, 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "findings": [
+            {
+                "rule": f.rule,
+                "severity": f.severity,
+                "path": (
+                    f.key(repo_root).split("::", 1)[0]
+                    if repo_root is not None
+                    else f.path.as_posix()
+                ),
+                "line": f.line,
+                "message": f.message,
+            }
+            for f in result.findings
+        ],
+        "counts": counts,
+        "baselined": result.baselined,
+        "total": len(result.findings),
+    }
+
+
+def from_json(doc: dict) -> RunResult:
+    """Inverse of :func:`to_json` (used by the schema round-trip test and
+    by tooling that post-processes saved runs)."""
+    if doc.get("version") != JSON_SCHEMA_VERSION:
+        raise ValueError(f"unsupported schema version {doc.get('version')!r}")
+    result = RunResult(baselined=int(doc.get("baselined", 0)))
+    for entry in doc["findings"]:
+        result.findings.append(
+            Finding(
+                rule=entry["rule"],
+                path=Path(entry["path"]),
+                line=int(entry["line"]),
+                message=entry["message"],
+                severity=entry.get("severity", "error"),
+            )
+        )
+    return result

@@ -1,0 +1,247 @@
+"""Dynamic lock-order harness — the stand-in for ``go test -race``.
+
+A :class:`LockMonitor` hands out instrumented Lock/RLock/Condition
+objects (via a ``threading``-compatible proxy module that tests
+monkeypatch into the modules under test).  Every acquisition records,
+per thread, the set of locks already held; the cross-thread union of
+those (held, acquired) pairs is the lock-acquisition graph.  A cycle in
+that graph is a potential deadlock even if this particular run never
+interleaved into it — exactly the class of bug a single green test run
+cannot rule out.
+
+Identity is the lock's *creation site* (file:line), not the instance:
+the transport creates one Condition per peer channel from the same
+line, and "channel A held while acquiring channel B" must aggregate to
+one node for the ordering to mean anything.  The flip side: self-edges
+(same-site lock while holding a same-site lock) are skipped, since
+distinct instances from one site are indistinguishable here — a
+same-site ordering protocol cannot be validated by this harness and
+must be argued in code review instead.
+
+Condition ``wait()`` releases and reacquires its lock; the reacquire is
+not a fresh ordered acquisition (the thread already owned the lock when
+it called wait), so it restores held-state without recording edges.
+
+Usage (see tests/test_pipeline.py / tests/test_cluster.py):
+
+    monitor = LockMonitor()
+    proxy = monitor.threading_proxy()
+    monkeypatch.setattr(processor_module, "threading", proxy)
+    ... exercise the system ...
+    monitor.assert_no_cycles()
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+
+
+class LockOrderViolation(AssertionError):
+    """A cycle in the cross-thread lock-acquisition graph."""
+
+
+def _creation_site() -> str:
+    """file:line of the caller that constructed the lock, skipping
+    frames inside this module."""
+    for frame in reversed(traceback.extract_stack()):
+        if frame.filename != __file__:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+class LockMonitor:
+    def __init__(self):
+        self._meta = threading.Lock()  # guards _edges only
+        self._local = threading.local()
+        # (held site, acquired site) -> witness description
+        self._edges: dict[tuple[str, str], str] = {}
+
+    # -- recording -----------------------------------------------------------
+
+    def _held(self) -> list[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _note_acquired(self, site: str, record_edges: bool = True) -> None:
+        held = self._held()
+        if record_edges and site not in held:
+            thread = threading.current_thread().name
+            with self._meta:
+                for prior in held:
+                    if prior != site:  # same-site: see module docstring
+                        self._edges.setdefault(
+                            (prior, site), f"thread {thread}"
+                        )
+        held.append(site)
+
+    def _note_released(self, site: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    # -- graph ---------------------------------------------------------------
+
+    def edges(self) -> dict[tuple[str, str], str]:
+        with self._meta:
+            return dict(self._edges)
+
+    def find_cycle(self) -> list[str] | None:
+        """A list of sites forming a cycle (first == last), or None."""
+        edges = self.edges()
+        graph: dict[str, list[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, []).append(b)
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {node: WHITE for node in graph}
+        path: list[str] = []
+
+        def dfs(node: str) -> list[str] | None:
+            color[node] = GREY
+            path.append(node)
+            for nxt in sorted(graph.get(node, ())):
+                if color.get(nxt, WHITE) == GREY:
+                    return path[path.index(nxt) :] + [nxt]
+                if color.get(nxt, WHITE) == WHITE:
+                    color[nxt] = WHITE
+                    found = dfs(nxt)
+                    if found is not None:
+                        return found
+            path.pop()
+            color[node] = BLACK
+            return None
+
+        for node in sorted(graph):
+            if color.get(node, WHITE) == WHITE:
+                found = dfs(node)
+                if found is not None:
+                    return found
+        return None
+
+    def assert_no_cycles(self) -> None:
+        cycle = self.find_cycle()
+        if cycle is None:
+            return
+        edges = self.edges()
+        lines = [
+            "lock-order cycle (potential deadlock):",
+        ]
+        for a, b in zip(cycle, cycle[1:]):
+            lines.append(f"  {a} held while acquiring {b} ({edges[a, b]})")
+        raise LockOrderViolation("\n".join(lines))
+
+    # -- instrumented primitives --------------------------------------------
+
+    def Lock(self):
+        return _InstrumentedLock(self, threading.Lock(), _creation_site())
+
+    def RLock(self):
+        return _InstrumentedLock(self, threading.RLock(), _creation_site())
+
+    def Condition(self, lock=None):
+        if isinstance(lock, _InstrumentedLock):
+            inner = threading.Condition(lock._inner)
+            site = lock._site  # holding the cv IS holding the lock
+        else:
+            inner = threading.Condition(lock)
+            site = _creation_site()
+        return _InstrumentedCondition(self, inner, site)
+
+    def threading_proxy(self):
+        """A ``threading``-shaped namespace whose Lock/RLock/Condition
+        are instrumented; everything else (Thread, Event, local, ...)
+        forwards to the real module."""
+        return _ThreadingProxy(self)
+
+
+class _InstrumentedLock:
+    def __init__(self, monitor: LockMonitor, inner, site: str):
+        self._monitor = monitor
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._monitor._note_acquired(self._site)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._monitor._note_released(self._site)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _InstrumentedCondition:
+    def __init__(self, monitor: LockMonitor, inner, site: str):
+        self._monitor = monitor
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, *args, **kwargs) -> bool:
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._monitor._note_acquired(self._site)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._monitor._note_released(self._site)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout=None):
+        self._monitor._note_released(self._site)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            # reacquisition of a lock we already owned: no new edges
+            self._monitor._note_acquired(self._site, record_edges=False)
+
+    def wait_for(self, predicate, timeout=None):
+        self._monitor._note_released(self._site)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._monitor._note_acquired(self._site, record_edges=False)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+class _ThreadingProxy:
+    def __init__(self, monitor: LockMonitor):
+        self._monitor = monitor
+
+    def Lock(self):
+        return self._monitor.Lock()
+
+    def RLock(self):
+        return self._monitor.RLock()
+
+    def Condition(self, lock=None):
+        return self._monitor.Condition(lock)
+
+    def __getattr__(self, name: str):
+        return getattr(threading, name)

@@ -1,0 +1,334 @@
+"""Concurrency checker (C2xx): the ``# guarded-by:`` convention.
+
+Annotation forms (docs/ANALYSIS.md):
+
+    self._inflight = 0  # guarded-by: _mutex
+        Every later load/store of ``self._inflight`` must sit lexically
+        inside ``with self._mutex:`` (or a Condition aliasing it).
+
+    def _write_locked(self, ...):  # holds: _lock
+        The method requires the lock held by its caller: its body is
+        exempt from C201 for that lock, and every call site must itself
+        sit inside ``with <that lock>`` (C202).
+
+Conventions the checker understands:
+
+- Condition aliases are auto-detected: ``self._cv =
+  threading.Condition(self._mutex)`` makes ``with self._cv:`` satisfy
+  guards on ``_mutex`` and vice versa.
+- ``__init__`` bodies are exempt for their own ``self.*`` attributes —
+  the object is not yet shared during construction.
+- Cross-object access: ``peer._attr`` guarded by ``_lock`` is satisfied
+  by ``with peer._lock:`` — the *same base expression* must hold the
+  lock (matched structurally, so aliasing through a different variable
+  is conservatively flagged).
+- A nested ``def``/``lambda`` does not inherit the enclosing ``with``:
+  closures run later, on whichever thread calls them.
+
+The checker is annotation-driven: files without annotations produce no
+findings, so it is safe to run repo-wide.  It is lexical, not a race
+detector — the dynamic half (lock-order cycles) lives in
+``tools/analysis/lockorder.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import FileContext, Finding, Rule, register
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?([A-Za-z_]\w*)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*(?:self\.)?([A-Za-z_]\w*)")
+
+
+class _ClassModel:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.guarded: dict[str, str] = {}  # attr -> canonical lock
+        self.aliases: dict[str, str] = {}  # cv name -> wrapped lock name
+        self.holds: dict[str, set[str]] = {}  # method -> canonical locks
+        self.self_attrs: set[str] = set()  # every self.X ever assigned
+
+    def canonical(self, lock: str) -> str:
+        seen = set()
+        while lock in self.aliases and lock not in seen:
+            seen.add(lock)
+            lock = self.aliases[lock]
+        return lock
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_class(ctx: FileContext, node: ast.ClassDef) -> _ClassModel:
+    model = _ClassModel(node)
+    annots: dict[int, str] = {}
+    holds_annots: dict[int, str] = {}
+    end = node.end_lineno or node.lineno
+    for lineno in range(node.lineno, end + 1):
+        line = ctx.lines[lineno - 1] if lineno - 1 < len(ctx.lines) else ""
+        match = _GUARDED_RE.search(line)
+        if match:
+            annots[lineno] = match.group(1)
+        match = _HOLDS_RE.search(line)
+        if match:
+            holds_annots[lineno] = match.group(1)
+
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+            )
+            for target in targets:
+                attr = _self_attr(target)
+                if attr is None:
+                    continue
+                model.self_attrs.add(attr)
+                # A multi-line assignment carries its annotation on the
+                # closing line.
+                lock = annots.get(sub.lineno) or annots.get(
+                    sub.end_lineno or sub.lineno
+                )
+                if lock is not None:
+                    model.guarded[attr] = lock
+            # threading.Condition(self.X) alias detection
+            value = getattr(sub, "value", None)
+            if (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "Condition"
+                and value.args
+            ):
+                wrapped = _self_attr(value.args[0])
+                for target in targets:
+                    cv = _self_attr(target)
+                    if cv is not None and wrapped is not None:
+                        model.aliases[cv] = wrapped
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lock = holds_annots.get(sub.lineno)
+            if lock is not None:
+                model.holds.setdefault(sub.name, set()).add(lock)
+    # canonicalize holds and guards through the alias map
+    model.holds = {
+        name: {model.canonical(lock) for lock in locks}
+        for name, locks in model.holds.items()
+    }
+    model.guarded = {
+        attr: model.canonical(lock) for attr, lock in model.guarded.items()
+    }
+    return model
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one method body tracking lexically-held locks."""
+
+    def __init__(
+        self,
+        ctx: FileContext,
+        model: _ClassModel,
+        file_guarded: dict[str, set[str]],
+        method: ast.FunctionDef,
+    ):
+        self.ctx = ctx
+        self.model = model
+        self.file_guarded = file_guarded
+        self.method = method
+        self.is_init = method.name == "__init__"
+        self.method_holds = model.holds.get(method.name, set())
+        # (base ast.dump, canonical lock name) currently held lexically
+        self.held: set[tuple[str, str]] = set()
+        self.findings: list[Finding] = []
+
+    # -- with tracking -------------------------------------------------------
+
+    def _locks_of(self, expr: ast.AST) -> set[tuple[str, str]]:
+        if not isinstance(expr, ast.Attribute):
+            return set()
+        base_dump = ast.dump(expr.value)
+        return {(base_dump, self.model.canonical(expr.attr))}
+
+    def visit_With(self, node: ast.With) -> None:
+        added = set()
+        for item in node.items:
+            added |= self._locks_of(item.context_expr) - self.held
+        self.held |= added
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= added
+
+    visit_AsyncWith = visit_With
+
+    # -- nested callables do not inherit the enclosing with ------------------
+
+    def _visit_nested(self, node: ast.AST) -> None:
+        saved = self.held
+        self.held = set()
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.held = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_nested(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- accesses ------------------------------------------------------------
+
+    def _held_for(self, base_dump: str, lock: str) -> bool:
+        return (base_dump, lock) in self.held or lock in self.method_holds
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = node.attr
+        is_self = (
+            isinstance(node.value, ast.Name) and node.value.id == "self"
+        )
+        lock: str | None = None
+        if is_self:
+            lock = self.model.guarded.get(attr)
+            if lock is not None and self.is_init:
+                lock = None  # construction: not yet shared
+        elif attr in self.file_guarded:
+            locks = self.file_guarded[attr]
+            lock = next(iter(locks)) if len(locks) == 1 else None
+            # ambiguous multi-class guards are skipped (scope the rule
+            # rather than guess); single declarations check structurally
+        if lock is not None and attr != lock:
+            base_dump = ast.dump(node.value)
+            if not self._held_for(base_dump, lock):
+                self.findings.append(
+                    Finding(
+                        "C201",
+                        self.ctx.path,
+                        node.lineno,
+                        f"attribute '{attr}' (guarded-by {lock}) accessed "
+                        f"outside 'with {lock}'",
+                    )
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.is_init:
+            # Construction: the object is not yet shared, so helpers that
+            # normally require the lock may run bare (e.g. replay/compact
+            # before the lock even exists).
+            self.generic_visit(node)
+            return
+        func = node.func
+        callee: str | None = None
+        base_dump: str | None = None
+        if isinstance(func, ast.Attribute):
+            callee = func.attr
+            base_dump = ast.dump(func.value)
+        elif isinstance(func, ast.Name):
+            callee = func.id
+        if callee is not None:
+            required = self.model.holds.get(callee)
+            if callee == self.model.node.name:
+                required = self.model.holds.get("__init__")
+                base_dump = None  # constructor: lock lives on another object
+            if required:
+                for lock in sorted(required):
+                    if base_dump is not None:
+                        ok = self._held_for(base_dump, lock)
+                    else:
+                        ok = (
+                            any(h[1] == lock for h in self.held)
+                            or lock in self.method_holds
+                        )
+                    if not ok:
+                        self.findings.append(
+                            Finding(
+                                "C202",
+                                self.ctx.path,
+                                node.lineno,
+                                f"call to '{callee}' (holds: {lock}) "
+                                f"outside 'with {lock}'",
+                            )
+                        )
+        self.generic_visit(node)
+
+
+def check_guarded_by(ctx: FileContext) -> list[Finding]:
+    findings: list[Finding] = []
+    models = [
+        _collect_class(ctx, node)
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.ClassDef)
+    ]
+    # attr -> set of canonical lock names, across every class in the file
+    # (cross-object accesses can't know the owning class statically)
+    file_guarded: dict[str, set[str]] = {}
+    for model in models:
+        for attr, lock in model.guarded.items():
+            file_guarded.setdefault(attr, set()).add(lock)
+
+    for model in models:
+        # C203: annotation hygiene — the named lock must exist
+        for attr, lock in sorted(model.guarded.items()):
+            if lock not in model.self_attrs:
+                findings.append(
+                    Finding(
+                        "C203",
+                        ctx.path,
+                        model.node.lineno,
+                        f"guarded-by on '{attr}' names unknown lock "
+                        f"'{lock}' (no self.{lock} assignment in class "
+                        f"{model.node.name})",
+                    )
+                )
+        # (holds: locks are deliberately not validated against
+        # self_attrs — the required lock may live on another object, as
+        # with _PeerChannel.__init__ holding the transport's _lock.)
+        for sub in model.node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                checker = _MethodChecker(ctx, model, file_guarded, sub)
+                for stmt in sub.body:
+                    checker.visit(stmt)
+                findings.extend(checker.findings)
+    return findings
+
+
+register(
+    Rule(
+        id="C201",
+        title="guarded attribute accessed without its lock",
+        doc=(
+            "Every load/store of a `# guarded-by: L` attribute must sit "
+            "lexically inside `with <base>.L:` (Condition aliases count; "
+            "__init__ is exempt; nested defs do not inherit the with)."
+        ),
+        check=check_guarded_by,
+    )
+)
+register(
+    Rule(
+        id="C202",
+        title="holds-annotated callee without the lock",
+        doc=(
+            "A `# holds: L` method requires L held by the caller; every "
+            "call site must sit inside `with <base>.L:`.  Emitted by the "
+            "C201 checker."
+        ),
+        check=None,
+    )
+)
+register(
+    Rule(
+        id="C203",
+        title="guarded-by/holds names an unknown lock",
+        doc=(
+            "The lock named by an annotation must be assigned as a self "
+            "attribute in the class.  Emitted by the C201 checker."
+        ),
+        check=None,
+    )
+)

@@ -1,0 +1,372 @@
+"""Determinism purity auditor (D1xx).
+
+The reference's correctness story rests on one invariant: the state
+machine is a single-threaded, deterministic function of StateEvents that
+never touches I/O, clocks, or randomness (Mir-BFT, arXiv:1906.05552;
+the replayable-execution discipline inherited from PBFT).  This module
+proves it *transitively*: it builds the module-level import graph over
+``mirbft_tpu/`` and walks it from the purity roots —
+
+- everything under ``mirbft_tpu/core/``
+- the deterministic testengine paths: ``testengine/engine.py``,
+  ``testengine/manglers.py``, ``testengine/certs.py``
+
+— flagging every impure effect any reached module can perform:
+
+- D101  impure stdlib import (clock, socket, threading, process, file
+        or env I/O, OS entropy) reachable from a purity root
+- D102  direct impure builtin call (``open``/``input``/``breakpoint``)
+        in a pure module
+- D103  ``id()`` in a pure module — an address-dependent value; anything
+        derived from it diverges between the live run and a replay
+- D104  iteration over a ``set`` in a pure module without a ``sorted()``
+        wrap — str/bytes set order is PYTHONHASHSEED-dependent, so any
+        ordered protocol state fed from it diverges across processes
+
+Traversal stops at the sanctioned impurity boundaries (the Actions seam
+analog): the telemetry switchboard ``mirbft_tpu.obsv.hooks`` — pure
+modules may *record through* it, guarded by ``hooks.enabled``, but the
+auditor neither follows its edges nor audits its body.  Third-party
+imports (numpy/jax) are the accelerator substrate and are trusted;
+``random`` is deliberately NOT impure here because W12 already bans
+every unseeded spelling package-wide, so a surviving ``random`` use is a
+seeded ``random.Random(seed)`` — deterministic by construction.
+
+Per-module exemptions live in ``ALLOWLIST_IMPORTS`` with a mandatory
+justification string, mirrored in docs/ANALYSIS.md.  Keep it short: an
+allowlist entry is a documented hole in the proof.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Finding, Rule, register
+
+# stdlib top-level module -> effect description.  Importing one of these
+# from a pure module is D101 unless allowlisted.
+IMPURE_MODULES: dict[str, str] = {
+    "time": "wall clock / timers",
+    "datetime": "wall clock",
+    "socket": "socket I/O",
+    "select": "socket I/O",
+    "selectors": "socket I/O",
+    "ssl": "socket I/O",
+    "http": "socket I/O",
+    "urllib": "socket I/O",
+    "asyncio": "event loop / socket I/O",
+    "threading": "threads",
+    "queue": "thread synchronization",
+    "concurrent": "thread/process pools",
+    "subprocess": "process control",
+    "multiprocessing": "process control",
+    "signal": "process control",
+    "os": "file/env I/O",
+    "sys": "interpreter/environment state",
+    "pathlib": "file I/O surface",
+    "shutil": "file I/O",
+    "tempfile": "file I/O",
+    "glob": "file I/O",
+    "fileinput": "file I/O",
+    "secrets": "OS entropy",
+    "uuid": "OS entropy / host identity",
+}
+
+# Sanctioned impurity boundaries: edges into these modules are allowed
+# and traversal stops there.  hooks is the telemetry switchboard every
+# instrumented module records through (guarded by ``hooks.enabled``);
+# it is the Python port's analog of the reference's Actions seam — the
+# one doorway through which the pure world touches the impure one.
+BOUNDARY_MODULES = frozenset({"mirbft_tpu.obsv.hooks"})
+
+# module -> {stdlib top-level name: justification}.  Mirrored in
+# docs/ANALYSIS.md; every entry is a documented hole in the proof.
+ALLOWLIST_IMPORTS: dict[str, dict[str, str]] = {
+    "mirbft_tpu.core.state_machine": {
+        "time": (
+            "time.perf_counter telemetry behind hooks.enabled only; the "
+            "event-handling contract itself stays clock-free"
+        ),
+    },
+}
+
+DETERMINISTIC_TESTENGINE = frozenset(
+    {
+        "mirbft_tpu.testengine.engine",
+        "mirbft_tpu.testengine.manglers",
+        "mirbft_tpu.testengine.certs",
+    }
+)
+
+_IMPURE_BUILTINS = ("open", "input", "breakpoint", "exec", "eval")
+
+
+def module_name(posix: str) -> str | None:
+    """Resolved posix path -> dotted module name, or None for files
+    outside a ``mirbft_tpu/`` tree.  Fragment-based so synthetic trees
+    under tmp_path audit exactly like the real package."""
+    idx = posix.rfind("mirbft_tpu/")
+    if idx < 0 or not posix.endswith(".py"):
+        return None
+    name = posix[idx:-3].replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+def is_purity_root(name: str) -> bool:
+    return (
+        name == "mirbft_tpu.core"
+        or name.startswith("mirbft_tpu.core.")
+        or name in DETERMINISTIC_TESTENGINE
+    )
+
+
+class _ModuleInfo:
+    def __init__(self, name: str, ctx: FileContext, is_package: bool):
+        self.name = name
+        self.ctx = ctx
+        # Anchor for level-1 relative imports: the package itself for
+        # __init__.py, the containing package for regular modules.
+        self.package = name if is_package else name.rsplit(".", 1)[0]
+
+
+def _edges_and_imports(
+    info: _ModuleInfo, project: set[str]
+) -> tuple[set[str], list[tuple[int, str]]]:
+    """(intra-package edges, [(line, impure top-level stdlib name)]).
+
+    Function-level imports count too — a lazy ``import time`` inside a
+    handler is exactly the effect the audit exists to catch."""
+    edges: set[str] = set()
+    external: list[tuple[int, str]] = []
+
+    def _external(lineno: int, dotted: str) -> None:
+        top = dotted.split(".")[0]
+        if top in IMPURE_MODULES:
+            external.append((lineno, top))
+
+    for node in ast.walk(info.ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in project:
+                    edges.add(alias.name)
+                else:
+                    _external(node.lineno, alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                resolved = node.module or ""
+            else:
+                parts = info.package.split(".")
+                parts = parts[: len(parts) - (node.level - 1)]
+                if node.module:
+                    parts.append(node.module)
+                resolved = ".".join(parts)
+            if not resolved:
+                continue
+            if resolved == "__future__":
+                continue
+            for alias in node.names:
+                candidate = f"{resolved}.{alias.name}"
+                if candidate in project:
+                    edges.add(candidate)
+                elif resolved in project:
+                    edges.add(resolved)
+                else:
+                    _external(node.lineno, resolved)
+    return edges, external
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("set", "frozenset")
+    )
+
+
+def _direct_effects(ctx: FileContext) -> list[Finding]:
+    """D102/D103/D104 findings for one pure module's own body."""
+    out: list[Finding] = []
+    # Iteration sites that are arguments of sorted(...) are sanctioned.
+    sorted_args = {
+        id(arg)
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Name)
+        and n.func.id == "sorted"
+        for arg in n.args
+    }
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id in _IMPURE_BUILTINS:
+                out.append(
+                    Finding(
+                        "D102",
+                        ctx.path,
+                        node.lineno,
+                        f"impure builtin {node.func.id}() in a pure module",
+                    )
+                )
+            elif node.func.id == "id" and node.args:
+                out.append(
+                    Finding(
+                        "D103",
+                        ctx.path,
+                        node.lineno,
+                        "id() in a pure module (address-dependent value "
+                        "diverges between live run and replay)",
+                    )
+                )
+            elif (
+                node.func.id in ("list", "tuple", "enumerate", "iter")
+                and node.args
+                and _is_set_expr(node.args[0])
+            ):
+                out.append(
+                    Finding(
+                        "D104",
+                        ctx.path,
+                        node.lineno,
+                        f"{node.func.id}() over a set in a pure module "
+                        "(hash-seed-dependent order; wrap in sorted())",
+                    )
+                )
+        iters: list[ast.AST] = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.DictComp)):
+            iters.extend(gen.iter for gen in node.generators)
+        for it in iters:
+            if _is_set_expr(it) and id(it) not in sorted_args:
+                out.append(
+                    Finding(
+                        "D104",
+                        ctx.path,
+                        it.lineno,
+                        "iteration over a set in a pure module "
+                        "(hash-seed-dependent order; wrap in sorted())",
+                    )
+                )
+    return out
+
+
+def check_purity(contexts: list[FileContext]) -> list[Finding]:
+    modules: dict[str, _ModuleInfo] = {}
+    for ctx in contexts:
+        name = module_name(ctx.posix)
+        if name is not None:
+            modules[name] = _ModuleInfo(
+                name, ctx, ctx.posix.endswith("/__init__.py")
+            )
+
+    project = set(modules)
+    graph: dict[str, set[str]] = {}
+    external: dict[str, list[tuple[int, str]]] = {}
+    for name, info in modules.items():
+        graph[name], external[name] = _edges_and_imports(info, project)
+
+    roots = sorted(n for n in modules if is_purity_root(n))
+    # name -> import chain from the first root that reached it.
+    chain: dict[str, tuple[str, ...]] = {}
+    queue: list[str] = []
+    for root in roots:
+        if root not in chain:
+            chain[root] = (root,)
+            queue.append(root)
+    while queue:
+        current = queue.pop(0)
+        if current in BOUNDARY_MODULES:
+            continue
+        for dep in sorted(graph.get(current, ())):
+            if dep not in chain:
+                chain[dep] = chain[current] + (dep,)
+                queue.append(dep)
+
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str]] = set()
+    for name in sorted(chain):
+        if name in BOUNDARY_MODULES:
+            continue
+        info = modules[name]
+        via = " -> ".join(chain[name])
+        allowed = ALLOWLIST_IMPORTS.get(name, {})
+        for lineno, top in external.get(name, []):
+            if top in allowed:
+                continue
+            key = (info.ctx.posix, lineno, top)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(
+                Finding(
+                    "D101",
+                    info.ctx.path,
+                    lineno,
+                    f"impure import '{top}' ({IMPURE_MODULES[top]}) "
+                    f"reachable from purity root (via {via})",
+                )
+            )
+        for finding in _direct_effects(info.ctx):
+            key = (info.ctx.posix, finding.line, finding.rule)
+            if key in seen:
+                continue
+            seen.add(key)
+            finding.message += f" (via {via})"
+            findings.append(finding)
+    return findings
+
+
+register(
+    Rule(
+        id="D101",
+        title="impure import reachable from a purity root",
+        doc=(
+            "core/ and the deterministic testengine must never "
+            "transitively import clocks, sockets, threads, processes, "
+            "file/env I/O, or OS entropy; exemptions live in "
+            "ALLOWLIST_IMPORTS with a justification."
+        ),
+        check=check_purity,
+        project=True,
+    )
+)
+register(
+    Rule(
+        id="D102",
+        title="impure builtin call in a pure module",
+        doc=(
+            "open()/input()/breakpoint()/exec()/eval() in a module "
+            "reachable from a purity root.  Emitted by the D101 "
+            "traversal."
+        ),
+        check=None,
+    )
+)
+register(
+    Rule(
+        id="D103",
+        title="id() in a pure module",
+        doc=(
+            "id() yields an address-dependent value; anything derived "
+            "from it diverges between the live run and a replay.  "
+            "Emitted by the D101 traversal."
+        ),
+        check=None,
+    )
+)
+register(
+    Rule(
+        id="D104",
+        title="set iteration in a pure module",
+        doc=(
+            "str/bytes set iteration order is PYTHONHASHSEED-dependent; "
+            "ordered protocol state fed from it diverges across "
+            "processes.  Wrap the set in sorted().  Emitted by the D101 "
+            "traversal."
+        ),
+        check=None,
+    )
+)

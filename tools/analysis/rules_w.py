@@ -1,0 +1,618 @@
+"""General defect classes W1..W12 (the original tools/lint.py checks as
+Rule objects, message-compatible, plus the seeded-randomness ban).
+
+The catalog (rationale per rule lives in docs/ANALYSIS.md):
+
+- W1 unused import            (dead seams hide refactor mistakes)
+- W2 bare ``except:``         (swallows KeyboardInterrupt/SystemExit)
+- W3 assert on a tuple literal (always true — a silently-disabled check)
+- W4 ``is``/``is not`` against str/int literals (identity vs equality)
+- W5 mutable default argument  (shared-state bug factory)
+- W6 f-string with no placeholders (usually a forgotten interpolation)
+- W7 wall-clock ``time.time()`` in monotonic-only code
+- W8 ``http.server`` outside ``mirbft_tpu/obsv/``
+- W9 raw ``socket`` outside transport.py / chaos/live.py
+- W10 ``os.fsync`` outside storage.py; raw Thread in processor.py
+- W11 ``subprocess``/``multiprocessing`` outside ``mirbft_tpu/cluster/``
+- W12 unseeded ``random.*`` module-level functions and ``numpy.random``
+  legacy global state inside ``mirbft_tpu/`` — seeded
+  ``random.Random(seed)`` instances and ``jax.random`` keys only.
+  Seeded reproducibility is the chaos/testengine contract: every fault
+  schedule, mangler decision, arrival process, and jitter sequence must
+  replay from its seed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Finding, Rule, register
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Collect imported names and every name usage per module."""
+
+    def __init__(self):
+        self.imports: dict[str, tuple[int, str]] = {}  # name -> (line, what)
+        self.used: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            # ``import x as x`` is the conventional re-export idiom: keep.
+            if alias.asname is not None and alias.asname == alias.name:
+                continue
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directive, not a binding
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            if alias.asname is not None and alias.asname == alias.name:
+                continue
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+
+def _string_uses(tree: ast.Module) -> set[str]:
+    """Names referenced from ``__all__`` string entries (the re-export
+    idiom).  Only those assignments count — treating any identifier-shaped
+    string anywhere as a use would let a stray dict key mask a genuinely
+    unused import."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        if not any(
+            isinstance(t, ast.Name) and t.id == "__all__" for t in targets
+        ):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.add(sub.value)
+    return out
+
+
+# Path fragments whose files must never read the wall clock: span/metric
+# durations and simulated-time code.  testengine/eventlog.py (run metadata
+# timestamps) and bench/test files are deliberately outside the scope.
+MONOTONIC_ONLY_TREES = (
+    "mirbft_tpu/obsv/",
+    "mirbft_tpu/core/",
+    "mirbft_tpu/runtime/",
+    "mirbft_tpu/chaos/",
+    "mirbft_tpu/testengine/crypto_plane.py",
+    "mirbft_tpu/testengine/signing.py",
+)
+
+
+def in_monotonic_scope(posix: str) -> bool:
+    return any(fragment in posix for fragment in MONOTONIC_ONLY_TREES)
+
+
+def in_exposition_scope(posix: str) -> bool:
+    """True for mirbft_tpu files outside obsv/ — where W8 bans
+    http.server."""
+    return "mirbft_tpu/" in posix and "mirbft_tpu/obsv/" not in posix
+
+
+# The only two files allowed to touch raw sockets: the transport owns
+# framing/reconnect/counters, and the live chaos driver's partition
+# proxies sit deliberately *under* the transport at the socket layer.
+SOCKET_ALLOWED_FILES = (
+    "mirbft_tpu/runtime/transport.py",
+    "mirbft_tpu/chaos/live.py",
+)
+
+
+def in_socket_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W9 bans raw ``socket`` imports."""
+    return "mirbft_tpu/" in posix and not any(
+        posix.endswith(allowed) for allowed in SOCKET_ALLOWED_FILES
+    )
+
+
+# The only files allowed to call os.fsync: the stores own the
+# group-commit coalescer, and the live chaos driver's durable app log
+# models an application fsyncing its own state (deliberately outside the
+# group-commit path, like a real app would be).
+FSYNC_ALLOWED_FILES = (
+    "mirbft_tpu/runtime/storage.py",
+    "mirbft_tpu/chaos/live.py",
+)
+
+# The one module (and the one helper inside it) allowed to create
+# pipeline threads.
+THREAD_BAN_FILE = "mirbft_tpu/runtime/processor.py"
+THREAD_SPAWN_HELPER = "_spawn_stage"
+
+
+def in_fsync_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W10 bans ``os.fsync``."""
+    return "mirbft_tpu/" in posix and not any(
+        posix.endswith(allowed) for allowed in FSYNC_ALLOWED_FILES
+    )
+
+
+# The only tree allowed to manage OS processes: the cluster supervisor
+# owns spawn/handshake/kill/restart/teardown for process-per-node runs.
+PROCESS_ALLOWED_TREE = "mirbft_tpu/cluster/"
+
+# Modules whose import anywhere else in mirbft_tpu/ trips W11.
+PROCESS_MODULES = ("subprocess", "multiprocessing")
+
+
+def in_process_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W11 bans process-management
+    imports."""
+    return "mirbft_tpu/" in posix and PROCESS_ALLOWED_TREE not in posix
+
+
+def in_package_scope(posix: str) -> bool:
+    """True for files inside mirbft_tpu/ (W12's scope: tests, tools, and
+    bench may use ambient randomness freely)."""
+    return "mirbft_tpu/" in posix
+
+
+def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
+    """Line spans of every ``_spawn_stage`` definition (the only place
+    W10 permits ``threading.Thread(...)`` in the processor module)."""
+    return [
+        (node.lineno, node.end_lineno or node.lineno)
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name == THREAD_SPAWN_HELPER
+    ]
+
+
+# -- per-rule checkers -------------------------------------------------------
+
+
+def _check_w1(ctx: FileContext):
+    tracker = _ImportTracker()
+    tracker.visit(ctx.tree)
+    stringy = _string_uses(ctx.tree)
+    if ctx.path.name == "__init__.py":
+        return  # package __init__ imports are the public surface
+    for name, (line, what) in sorted(tracker.imports.items()):
+        if name in tracker.used or name in stringy:
+            continue
+        yield Finding("W1", ctx.path, line, f"unused import '{what}'")
+
+
+def _check_w2(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield Finding("W2", ctx.path, node.lineno, "bare 'except:'")
+
+
+def _check_w3(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple):
+            if node.test.elts:
+                yield Finding(
+                    "W3", ctx.path, node.lineno, "assert on tuple is always true"
+                )
+
+
+def _check_w4(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Is, ast.IsNot)) and isinstance(
+                comp, ast.Constant
+            ) and isinstance(comp.value, (str, int, bytes)) and not isinstance(
+                comp.value, bool
+            ):
+                yield Finding(
+                    "W4", ctx.path, node.lineno, "'is' comparison with literal"
+                )
+
+
+def _check_w5(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                yield Finding(
+                    "W5", ctx.path, default.lineno, "mutable default argument"
+                )
+
+
+def _check_w6(ctx: FileContext):
+    # Format specs (the ``:6d`` in an f-string) are themselves JoinedStr
+    # nodes; they must not trip the empty-f-string check.
+    spec_ids = {
+        id(n.format_spec)
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
+    }
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids:
+            if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+                yield Finding(
+                    "W6", ctx.path, node.lineno, "f-string without placeholders"
+                )
+
+
+def check_w7(ctx: FileContext):
+    """Exposed for the shim's ``monotonic_only`` forcing (scope is applied
+    by the registry in normal runs)."""
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "time"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "time"
+        ):
+            yield Finding(
+                "W7",
+                ctx.path,
+                node.lineno,
+                "wall-clock time.time() in monotonic-only code "
+                "(use time.perf_counter)",
+            )
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            if any(alias.name == "time" for alias in node.names):
+                yield Finding(
+                    "W7",
+                    ctx.path,
+                    node.lineno,
+                    "'from time import time' in monotonic-only code "
+                    "(use time.perf_counter)",
+                )
+
+
+def _check_w8(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        hit = False
+        if isinstance(node, ast.Import):
+            hit = any(
+                alias.name == "http.server"
+                or alias.name.startswith("http.server.")
+                for alias in node.names
+            )
+        elif isinstance(node, ast.ImportFrom):
+            hit = node.module is not None and (
+                node.module == "http.server"
+                or node.module.startswith("http.server.")
+                or (
+                    node.module == "http"
+                    and any(alias.name == "server" for alias in node.names)
+                )
+            )
+        if hit:
+            yield Finding(
+                "W8",
+                ctx.path,
+                node.lineno,
+                "http.server outside obsv/ (exposition must go through "
+                "obsv.exporter and the catalog renderer)",
+            )
+
+
+def _check_w9(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        hit = False
+        if isinstance(node, ast.Import):
+            hit = any(
+                alias.name == "socket" or alias.name.startswith("socket.")
+                for alias in node.names
+            )
+        elif isinstance(node, ast.ImportFrom):
+            hit = node.module is not None and (
+                node.module == "socket" or node.module.startswith("socket.")
+            )
+        if hit:
+            yield Finding(
+                "W9",
+                ctx.path,
+                node.lineno,
+                "raw socket outside runtime/transport.py and chaos/live.py "
+                "(wire I/O goes through the transport or the live driver's "
+                "partition proxies)",
+            )
+
+
+def _check_w10_fsync(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        hit = (
+            isinstance(node, ast.Attribute)
+            and node.attr == "fsync"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        ) or (
+            isinstance(node, ast.ImportFrom)
+            and node.module == "os"
+            and any(alias.name == "fsync" for alias in node.names)
+        )
+        if hit:
+            yield Finding(
+                "W10",
+                ctx.path,
+                node.lineno,
+                "os.fsync outside runtime/storage.py (durability goes "
+                "through the stores' sync()/sync_token() group-commit API)",
+            )
+
+
+def _check_w10_thread(ctx: FileContext):
+    if not ctx.posix.endswith(THREAD_BAN_FILE):
+        return
+    spawn_spans = _spawn_helper_spans(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        hit = (
+            isinstance(func, ast.Attribute)
+            and func.attr == "Thread"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"
+        ) or (isinstance(func, ast.Name) and func.id == "Thread")
+        if hit and not any(lo <= node.lineno <= hi for lo, hi in spawn_spans):
+            yield Finding(
+                "W10",
+                ctx.path,
+                node.lineno,
+                "raw threading.Thread in runtime/processor.py outside "
+                "_spawn_stage (stage threads go through the single "
+                "creation point)",
+            )
+
+
+def _check_w10(ctx: FileContext):
+    if in_fsync_ban_scope(ctx.posix):
+        yield from _check_w10_fsync(ctx)
+    yield from _check_w10_thread(ctx)
+
+
+def _check_w11(ctx: FileContext):
+    prefixes = tuple(m + "." for m in PROCESS_MODULES)
+    for node in ast.walk(ctx.tree):
+        hit = False
+        if isinstance(node, ast.Import):
+            hit = any(
+                alias.name in PROCESS_MODULES
+                or alias.name.startswith(prefixes)
+                for alias in node.names
+            )
+        elif isinstance(node, ast.ImportFrom):
+            hit = node.module is not None and (
+                node.module in PROCESS_MODULES
+                or node.module.startswith(prefixes)
+            )
+        if hit:
+            yield Finding(
+                "W11",
+                ctx.path,
+                node.lineno,
+                "subprocess/multiprocessing outside cluster/ (process "
+                "lifecycle goes through the cluster supervisor)",
+            )
+
+
+# random attributes that do NOT carry module-global RNG state.
+_RANDOM_ALLOWED_ATTRS = {"Random"}
+
+
+def check_w12(ctx: FileContext):
+    """Unseeded-randomness ban.  Allowed spellings: ``random.Random(...)``
+    instance construction (seed it for anything protocol-visible) and the
+    explicitly keyed ``jax.random`` API.  Everything else — the ``random``
+    module's global functions, ``random.SystemRandom``, and the whole
+    ``numpy.random`` legacy global-state API — draws from state no seed in
+    this repo controls."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if (
+                isinstance(base, ast.Name)
+                and base.id == "random"
+                and node.attr not in _RANDOM_ALLOWED_ATTRS
+            ):
+                yield Finding(
+                    "W12",
+                    ctx.path,
+                    node.lineno,
+                    f"unseeded random.{node.attr} (module-global RNG "
+                    "state; use a seeded random.Random(seed) instance or "
+                    "jax.random keys)",
+                )
+            if (
+                isinstance(base, ast.Attribute)
+                and base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id in ("np", "numpy")
+            ):
+                yield Finding(
+                    "W12",
+                    ctx.path,
+                    node.lineno,
+                    f"numpy.random.{node.attr} legacy global state (use a "
+                    "seeded random.Random(seed) instance or jax.random "
+                    "keys)",
+                )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "random":
+                for alias in node.names:
+                    if alias.name not in _RANDOM_ALLOWED_ATTRS:
+                        yield Finding(
+                            "W12",
+                            ctx.path,
+                            node.lineno,
+                            f"'from random import {alias.name}' (module-"
+                            "global RNG state; use a seeded "
+                            "random.Random(seed) instance)",
+                        )
+            elif node.module is not None and (
+                node.module == "numpy.random"
+                or node.module.startswith("numpy.random.")
+            ):
+                yield Finding(
+                    "W12",
+                    ctx.path,
+                    node.lineno,
+                    "numpy.random legacy global state (use a seeded "
+                    "random.Random(seed) instance or jax.random keys)",
+                )
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "numpy.random" or alias.name.startswith(
+                    "numpy.random."
+                ):
+                    yield Finding(
+                        "W12",
+                        ctx.path,
+                        node.lineno,
+                        "numpy.random legacy global state (use a seeded "
+                        "random.Random(seed) instance or jax.random keys)",
+                    )
+
+
+def _as_list(gen_fn):
+    def check(ctx):
+        return list(gen_fn(ctx))
+
+    return check
+
+
+register(
+    Rule(
+        id="W1",
+        title="unused import",
+        doc="Dead import seams hide refactor mistakes.",
+        check=_as_list(_check_w1),
+        severity="warning",
+    )
+)
+register(
+    Rule(
+        id="W2",
+        title="bare except",
+        doc="A bare `except:` swallows KeyboardInterrupt and SystemExit.",
+        check=_as_list(_check_w2),
+        severity="warning",
+    )
+)
+register(
+    Rule(
+        id="W3",
+        title="assert on tuple",
+        doc="`assert (x, 'msg')` is always true — a silently-disabled check.",
+        check=_as_list(_check_w3),
+        severity="warning",
+    )
+)
+register(
+    Rule(
+        id="W4",
+        title="is-comparison with literal",
+        doc="`is` against a str/int literal tests identity, not equality.",
+        check=_as_list(_check_w4),
+        severity="warning",
+    )
+)
+register(
+    Rule(
+        id="W5",
+        title="mutable default argument",
+        doc="Mutable defaults are shared across calls — a shared-state bug factory.",
+        check=_as_list(_check_w5),
+        severity="warning",
+    )
+)
+register(
+    Rule(
+        id="W6",
+        title="f-string without placeholders",
+        doc="Usually a forgotten interpolation.",
+        check=_as_list(_check_w6),
+        severity="warning",
+    )
+)
+register(
+    Rule(
+        id="W7",
+        title="wall clock in monotonic-only code",
+        doc=(
+            "Instrumented / latency-measuring paths must use "
+            "time.perf_counter — the wall clock steps under NTP and breaks "
+            "span nesting and histograms."
+        ),
+        check=_as_list(check_w7),
+        scope=in_monotonic_scope,
+    )
+)
+register(
+    Rule(
+        id="W8",
+        title="http.server outside obsv/",
+        doc=(
+            "Metric/status exposition must go through the obsv exporter "
+            "and its catalog renderer."
+        ),
+        check=_as_list(_check_w8),
+        scope=in_exposition_scope,
+    )
+)
+register(
+    Rule(
+        id="W9",
+        title="raw socket outside the transport",
+        doc=(
+            "All wire I/O flows through runtime/transport.py or the live "
+            "chaos driver's partition proxies."
+        ),
+        check=_as_list(_check_w9),
+        scope=in_socket_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W10",
+        title="durability/pipeline discipline",
+        doc=(
+            "os.fsync is confined to the stores' group-commit coalescer; "
+            "processor stage threads go through _spawn_stage."
+        ),
+        check=_as_list(_check_w10),
+        scope=lambda posix: "mirbft_tpu/" in posix,
+    )
+)
+register(
+    Rule(
+        id="W11",
+        title="process management outside cluster/",
+        doc=(
+            "subprocess/multiprocessing are confined to the cluster "
+            "supervisor's lifecycle machinery."
+        ),
+        check=_as_list(_check_w11),
+        scope=in_process_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W12",
+        title="unseeded randomness",
+        doc=(
+            "Unseeded random.* module functions and numpy.random legacy "
+            "global state are banned in mirbft_tpu/; seeded "
+            "random.Random(seed) instances and jax.random keys only."
+        ),
+        check=_as_list(check_w12),
+        scope=in_package_scope,
+    )
+)

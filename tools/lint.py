@@ -1,410 +1,99 @@
-"""Stdlib-only static-analysis gate.
+"""Static-analysis gate — thin CLI shim over ``tools/analysis/``.
 
 The reference CI runs staticcheck + the race detector on every build
-(reference: .travis.yml:16-18).  This environment ships no third-party
-linter, so the equivalent discipline is a small AST-based checker that
-enforces the defect classes that have actually bitten BFT codebases:
+(reference: .travis.yml:16-18).  The checks themselves live in the
+``tools/analysis`` package:
 
-- W1 unused import            (dead seams hide refactor mistakes)
-- W2 bare ``except:``         (swallows KeyboardInterrupt/SystemExit)
-- W3 assert on a tuple literal (always true — a silently-disabled check)
-- W4 ``is``/``is not`` against str/int literals (identity vs equality)
-- W5 mutable default argument  (shared-state bug factory)
-- W6 f-string with no placeholders (usually a forgotten interpolation)
-- W7 wall-clock ``time.time()`` in monotonic-only code (instrumented /
-  latency-measuring paths must use ``time.perf_counter`` — the wall
-  clock steps under NTP and breaks span nesting and histograms).  W7 is
-  *scoped*: it applies only to files under the trees named in
-  ``MONOTONIC_ONLY_TREES`` (or when forced via the ``monotonic_only``
-  parameter); eventlog timestamps, for example, legitimately want the
-  wall clock.
-- W8 ``http.server`` outside ``mirbft_tpu/obsv/`` — metric/status
-  exposition must go through the obsv exporter and its catalog
-  renderer; ad-hoc handlers writing registry internals onto sockets
-  bypass the catalog/cardinality contract.  Scoped to ``mirbft_tpu/``
-  (tests and tools may use HTTP clients/servers freely).
-- W9 raw ``socket`` outside ``mirbft_tpu/runtime/transport.py`` and
-  ``mirbft_tpu/chaos/live.py`` — all wire I/O flows through the
-  transport (framing, reconnect/backoff, counters, fault seam) or the
-  live chaos driver's partition proxies; a stray socket elsewhere
-  bypasses every one of those disciplines.  Scoped to ``mirbft_tpu/``
-  (tests and tools may open sockets freely).
-- W10 durability/pipeline discipline, two prongs.  (a) ``os.fsync``
-  outside ``mirbft_tpu/runtime/storage.py`` and the live chaos
-  driver's durable app log — the stores' group-commit coalescer is the
-  only fsync authority; a stray fsync elsewhere silently reintroduces
-  the per-batch sync cost the pipelined commit path exists to amortize.
-  (b) raw ``threading.Thread`` creation in
-  ``mirbft_tpu/runtime/processor.py`` outside the pipeline's
-  ``_spawn_stage`` helper — stage threads must go through the single
-  creation point so naming (``proc-pipe-*``), daemonization, and the
-  leak gate stay uniform.  Scoped to ``mirbft_tpu/``.
-- W11 ``subprocess``/``multiprocessing`` outside ``mirbft_tpu/cluster/``
-  — process management (spawn, readiness handshake, kill/restart,
-  teardown) is the cluster supervisor's whole job; a stray Popen or
-  Process elsewhere forks workers that escape the supervisor's
-  lifecycle, log capture, and teardown sweep.  Scoped to
-  ``mirbft_tpu/`` (tests, tools, and bench may fork freely).
+- ``analysis/rules_w.py`` — general defect classes W1..W12
+- ``analysis/rules_d.py`` — determinism purity auditor D101..D104
+  (transitive proof that core/ and the deterministic testengine never
+  reach an impure effect)
+- ``analysis/rules_c.py`` — concurrency checker C201..C203 (the
+  ``# guarded-by:`` / ``# holds:`` convention)
+- ``analysis/engine.py``  — registry, per-line suppressions
+  (``# lint: allow W7 <reason>`` — reason mandatory), committed
+  baseline, ``--json`` output
 
-Run: ``python tools/lint.py [paths...]`` — exits non-zero on findings.
-Also enforced in CI-equivalent form by ``tests/test_lint.py``.
+Run: ``python tools/lint.py [--json] [paths...]`` — exits non-zero on
+non-baselined findings.  Policy and the rule catalog: docs/ANALYSIS.md.
+
+This module keeps the original helper API (``check_file``, ``lint``,
+``_in_monotonic_scope``, the scope constants) so existing invocations
+and tests keep working unchanged.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
+# Allow both `python tools/lint.py` (tools/ becomes sys.path[0]) and
+# `import lint` from a test that put tools/ on sys.path.
+_TOOLS_DIR = str(Path(__file__).resolve().parent)
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
 
-class _ImportTracker(ast.NodeVisitor):
-    """Collect imported names and every name usage per module."""
+from analysis import cli as _cli  # noqa: E402
+from analysis import engine as _engine  # noqa: E402
+from analysis import rules_w as _rules_w  # noqa: E402
+from analysis.engine import FileContext, all_rules  # noqa: E402
 
-    def __init__(self):
-        self.imports: dict[str, tuple[int, str]] = {}  # name -> (line, what)
-        self.used: set[str] = set()
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            # ``import x as x`` is the conventional re-export idiom: keep.
-            if alias.asname is not None and alias.asname == alias.name:
-                continue
-            self.imports[name] = (node.lineno, alias.name)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return  # compiler directive, not a binding
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name
-            if alias.asname is not None and alias.asname == alias.name:
-                continue
-            self.imports[name] = (node.lineno, alias.name)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-
-
-def _string_uses(tree: ast.Module) -> set[str]:
-    """Names referenced from ``__all__`` string entries (the re-export
-    idiom).  Only those assignments count — treating any identifier-shaped
-    string anywhere as a use would let a stray dict key mask a genuinely
-    unused import."""
-    out: set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.Assign, ast.AugAssign)):
-            continue
-        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
-        if not any(
-            isinstance(t, ast.Name) and t.id == "__all__" for t in targets
-        ):
-            continue
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
-                out.add(sub.value)
-    return out
-
-
-# Path fragments whose files must never read the wall clock: span/metric
-# durations and simulated-time code.  testengine/eventlog.py (run metadata
-# timestamps) and bench/test files are deliberately outside the scope.
-MONOTONIC_ONLY_TREES = (
-    "mirbft_tpu/obsv/",
-    "mirbft_tpu/core/",
-    "mirbft_tpu/runtime/",
-    "mirbft_tpu/chaos/",
-    "mirbft_tpu/testengine/crypto_plane.py",
-    "mirbft_tpu/testengine/signing.py",
-)
+# Re-exported scope constants (part of the historical API).
+MONOTONIC_ONLY_TREES = _rules_w.MONOTONIC_ONLY_TREES
+SOCKET_ALLOWED_FILES = _rules_w.SOCKET_ALLOWED_FILES
+FSYNC_ALLOWED_FILES = _rules_w.FSYNC_ALLOWED_FILES
+THREAD_BAN_FILE = _rules_w.THREAD_BAN_FILE
+THREAD_SPAWN_HELPER = _rules_w.THREAD_SPAWN_HELPER
+PROCESS_ALLOWED_TREE = _rules_w.PROCESS_ALLOWED_TREE
+PROCESS_MODULES = _rules_w.PROCESS_MODULES
 
 
 def _in_monotonic_scope(path: Path) -> bool:
-    posix = path.resolve().as_posix()
-    return any(fragment in posix for fragment in MONOTONIC_ONLY_TREES)
-
-
-def _in_exposition_scope(path: Path) -> bool:
-    """True for mirbft_tpu files outside obsv/ — where W8 bans
-    http.server."""
-    posix = path.resolve().as_posix()
-    return "mirbft_tpu/" in posix and "mirbft_tpu/obsv/" not in posix
-
-
-# The only two files allowed to touch raw sockets: the transport owns
-# framing/reconnect/counters, and the live chaos driver's partition
-# proxies sit deliberately *under* the transport at the socket layer.
-SOCKET_ALLOWED_FILES = (
-    "mirbft_tpu/runtime/transport.py",
-    "mirbft_tpu/chaos/live.py",
-)
-
-
-def _in_socket_ban_scope(path: Path) -> bool:
-    """True for mirbft_tpu files where W9 bans raw ``socket`` imports."""
-    posix = path.resolve().as_posix()
-    return "mirbft_tpu/" in posix and not any(
-        posix.endswith(allowed) for allowed in SOCKET_ALLOWED_FILES
-    )
-
-
-# The only files allowed to call os.fsync: the stores own the
-# group-commit coalescer, and the live chaos driver's durable app log
-# models an application fsyncing its own state (deliberately outside the
-# group-commit path, like a real app would be).
-FSYNC_ALLOWED_FILES = (
-    "mirbft_tpu/runtime/storage.py",
-    "mirbft_tpu/chaos/live.py",
-)
-
-# The one module (and the one helper inside it) allowed to create
-# pipeline threads.
-THREAD_BAN_FILE = "mirbft_tpu/runtime/processor.py"
-THREAD_SPAWN_HELPER = "_spawn_stage"
-
-
-def _in_fsync_ban_scope(path: Path) -> bool:
-    """True for mirbft_tpu files where W10 bans ``os.fsync``."""
-    posix = path.resolve().as_posix()
-    return "mirbft_tpu/" in posix and not any(
-        posix.endswith(allowed) for allowed in FSYNC_ALLOWED_FILES
-    )
-
-
-# The only tree allowed to manage OS processes: the cluster supervisor
-# owns spawn/handshake/kill/restart/teardown for process-per-node runs.
-PROCESS_ALLOWED_TREE = "mirbft_tpu/cluster/"
-
-# Modules whose import anywhere else in mirbft_tpu/ trips W11.
-PROCESS_MODULES = ("subprocess", "multiprocessing")
-
-
-def _in_process_ban_scope(path: Path) -> bool:
-    """True for mirbft_tpu files where W11 bans process-management
-    imports."""
-    posix = path.resolve().as_posix()
-    return "mirbft_tpu/" in posix and PROCESS_ALLOWED_TREE not in posix
-
-
-def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
-    """Line spans of every ``_spawn_stage`` definition (the only place
-    W10 permits ``threading.Thread(...)`` in the processor module)."""
-    return [
-        (node.lineno, node.end_lineno or node.lineno)
-        for node in ast.walk(tree)
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-        and node.name == THREAD_SPAWN_HELPER
-    ]
+    return _rules_w.in_monotonic_scope(path.resolve().as_posix())
 
 
 def check_file(path: Path, monotonic_only: bool | None = None) -> list[str]:
-    """Lint one file.  ``monotonic_only`` forces the W7 wall-clock check
-    on (True) or off (False); None scopes it by MONOTONIC_ONLY_TREES."""
-    if monotonic_only is None:
-        monotonic_only = _in_monotonic_scope(path)
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as err:
-        return [f"{path}:{err.lineno}: E0 syntax error: {err.msg}"]
-
-    findings: list[str] = []
-
-    tracker = _ImportTracker()
-    tracker.visit(tree)
-    stringy = _string_uses(tree)
-    is_package_init = path.name == "__init__.py"
-    for name, (line, what) in sorted(tracker.imports.items()):
-        if name in tracker.used or name in stringy:
+    """Lint one file with the per-file rules.  ``monotonic_only`` forces
+    the W7 wall-clock check on (True) or off (False); None scopes it by
+    MONOTONIC_ONLY_TREES.  Project-wide rules (the D1xx auditor) need
+    the whole tree — use :func:`lint` or the CLI for those."""
+    ctx = FileContext(path)
+    if ctx.syntax_error is not None:
+        return [
+            f"{path}:{ctx.syntax_error.lineno}: E0 syntax error: "
+            f"{ctx.syntax_error.msg}"
+        ]
+    findings = []
+    for rule in all_rules():
+        if rule.check is None or rule.project:
             continue
-        if is_package_init:
-            continue  # package __init__ imports are the public surface
-        findings.append(f"{path}:{line}: W1 unused import '{what}'")
-
-    in_thread_ban_file = path.resolve().as_posix().endswith(THREAD_BAN_FILE)
-    spawn_spans = _spawn_helper_spans(tree) if in_thread_ban_file else []
-
-    # Format specs (the ``:6d`` in an f-string) are themselves JoinedStr
-    # nodes; they must not trip the W6 empty-f-string check.
-    spec_ids = {
-        id(n.format_spec)
-        for n in ast.walk(tree)
-        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
-    }
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(f"{path}:{node.lineno}: W2 bare 'except:'")
-        if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple):
-            if node.test.elts:
-                findings.append(
-                    f"{path}:{node.lineno}: W3 assert on tuple is always true"
-                )
-        if isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if isinstance(op, (ast.Is, ast.IsNot)) and isinstance(
-                    comp, ast.Constant
-                ) and isinstance(comp.value, (str, int, bytes)) and not isinstance(
-                    comp.value, bool
-                ):
-                    findings.append(
-                        f"{path}:{node.lineno}: W4 'is' comparison with literal"
-                    )
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None
-            ]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    findings.append(
-                        f"{path}:{default.lineno}: W5 mutable default argument"
-                    )
-        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids:
-            if not any(
-                isinstance(v, ast.FormattedValue) for v in node.values
-            ):
-                findings.append(
-                    f"{path}:{node.lineno}: W6 f-string without placeholders"
-                )
-        if monotonic_only:
-            if (
-                isinstance(node, ast.Attribute)
-                and node.attr == "time"
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "time"
-            ):
-                findings.append(
-                    f"{path}:{node.lineno}: W7 wall-clock time.time() in "
-                    "monotonic-only code (use time.perf_counter)"
-                )
-            if isinstance(node, ast.ImportFrom) and node.module == "time":
-                if any(alias.name == "time" for alias in node.names):
-                    findings.append(
-                        f"{path}:{node.lineno}: W7 'from time import time' in "
-                        "monotonic-only code (use time.perf_counter)"
-                    )
-        if _in_exposition_scope(path):
-            hit = False
-            if isinstance(node, ast.Import):
-                hit = any(
-                    alias.name == "http.server" or alias.name.startswith("http.server.")
-                    for alias in node.names
-                )
-            elif isinstance(node, ast.ImportFrom):
-                hit = node.module is not None and (
-                    node.module == "http.server"
-                    or node.module.startswith("http.server.")
-                    or (
-                        node.module == "http"
-                        and any(alias.name == "server" for alias in node.names)
-                    )
-                )
-            if hit:
-                findings.append(
-                    f"{path}:{node.lineno}: W8 http.server outside obsv/ "
-                    "(exposition must go through obsv.exporter and the "
-                    "catalog renderer)"
-                )
-        if _in_socket_ban_scope(path):
-            hit = False
-            if isinstance(node, ast.Import):
-                hit = any(
-                    alias.name == "socket" or alias.name.startswith("socket.")
-                    for alias in node.names
-                )
-            elif isinstance(node, ast.ImportFrom):
-                hit = node.module is not None and (
-                    node.module == "socket"
-                    or node.module.startswith("socket.")
-                )
-            if hit:
-                findings.append(
-                    f"{path}:{node.lineno}: W9 raw socket outside "
-                    "runtime/transport.py and chaos/live.py (wire I/O "
-                    "goes through the transport or the live driver's "
-                    "partition proxies)"
-                )
-        if _in_fsync_ban_scope(path):
-            hit = (
-                isinstance(node, ast.Attribute)
-                and node.attr == "fsync"
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "os"
-            ) or (
-                isinstance(node, ast.ImportFrom)
-                and node.module == "os"
-                and any(alias.name == "fsync" for alias in node.names)
+        if rule.id == "W7":
+            forced = (
+                monotonic_only
+                if monotonic_only is not None
+                else _rules_w.in_monotonic_scope(ctx.posix)
             )
-            if hit:
-                findings.append(
-                    f"{path}:{node.lineno}: W10 os.fsync outside "
-                    "runtime/storage.py (durability goes through the "
-                    "stores' sync()/sync_token() group-commit API)"
-                )
-        if _in_process_ban_scope(path):
-            hit = False
-            if isinstance(node, ast.Import):
-                hit = any(
-                    alias.name in PROCESS_MODULES
-                    or alias.name.startswith(tuple(m + "." for m in PROCESS_MODULES))
-                    for alias in node.names
-                )
-            elif isinstance(node, ast.ImportFrom):
-                hit = node.module is not None and (
-                    node.module in PROCESS_MODULES
-                    or node.module.startswith(
-                        tuple(m + "." for m in PROCESS_MODULES)
-                    )
-                )
-            if hit:
-                findings.append(
-                    f"{path}:{node.lineno}: W11 subprocess/multiprocessing "
-                    "outside cluster/ (process lifecycle goes through the "
-                    "cluster supervisor)"
-                )
-        if in_thread_ban_file and isinstance(node, ast.Call):
-            func = node.func
-            hit = (
-                isinstance(func, ast.Attribute)
-                and func.attr == "Thread"
-                and isinstance(func.value, ast.Name)
-                and func.value.id == "threading"
-            ) or (isinstance(func, ast.Name) and func.id == "Thread")
-            if hit and not any(
-                lo <= node.lineno <= hi for lo, hi in spawn_spans
-            ):
-                findings.append(
-                    f"{path}:{node.lineno}: W10 raw threading.Thread in "
-                    "runtime/processor.py outside _spawn_stage (stage "
-                    "threads go through the single creation point)"
-                )
-
-    return findings
+            if forced:
+                findings.extend(_rules_w.check_w7(ctx))
+            continue
+        if rule.scope is not None and not rule.scope(ctx.posix):
+            continue
+        findings.extend(rule.check(ctx))
+    findings = _engine._apply_suppressions([ctx], findings)
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return [f.render() for f in findings]
 
 
 def lint(paths: list[Path]) -> list[str]:
-    findings: list[str] = []
-    for root in paths:
-        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
-        for f in files:
-            findings.extend(check_file(f))
-    return findings
+    """Run the full suite (W+D+C) over ``paths`` with the committed
+    baseline applied; returns rendered finding lines."""
+    baseline = _engine.load_baseline(_cli.BASELINE_PATH)
+    result = _engine.run(paths, repo_root=_cli.REPO, baseline=baseline)
+    return result.render()
 
 
 def main(argv: list[str]) -> int:
-    repo = Path(__file__).resolve().parent.parent
-    targets = (
-        [Path(a) for a in argv]
-        if argv
-        else [repo / "mirbft_tpu", repo / "tests", repo / "tools",
-              repo / "bench.py", repo / "__graft_entry__.py"]
-    )
-    findings = lint(targets)
-    for line in findings:
-        print(line)
-    print(f"lint: {len(findings)} finding(s)")
-    return 1 if findings else 0
+    return _cli.main(argv)
 
 
 if __name__ == "__main__":
