@@ -118,6 +118,13 @@ LIVE_MP_STEP_DURATION_S = 2.0
 LIVE_MP_DRAIN_S = 25.0
 LIVE_MP_BATCH_SIZE = 4
 
+# Attack rung: the paper's request-duplication flood at the client seam
+# — every submission delivered (1 + copies) times to every node.  The
+# dedup tax is the goodput/p95 delta against a clean A/B baseline run in
+# the same stage (not against live_serial, whose run doesn't record
+# per-commit timestamps).
+LIVE_ATTACK_COPIES = 3
+
 
 def kernel_microbench():
     import hashlib
@@ -518,6 +525,8 @@ class _MemChainLog:
         self._hashlib = hashlib
         self.chain = b""
         self.commits: set = set()  # {(client_id, req_no)}
+        # First-commit instants, for the attack rung's p95 (perf_counter).
+        self.commit_times: dict = {}  # {(client_id, req_no): when}
 
     def apply(self, q_entry) -> None:
         for ack in q_entry.requests:
@@ -525,19 +534,30 @@ class _MemChainLog:
             h.update(self.chain)
             h.update(ack.digest)
             self.chain = h.digest()
-            self.commits.add((ack.client_id, ack.req_no))
+            key = (ack.client_id, ack.req_no)
+            if key not in self.commits:
+                self.commits.add(key)
+                self.commit_times[key] = time.perf_counter()
 
     def snap(self, network_config, clients_state) -> bytes:
         return self.chain
 
 
-def live_cluster_rate(kind: str) -> float:
+def live_cluster_rate(kind: str, flood_copies: int = 0, detailed: bool = False):
     """Committed reqs/sec on a real loopback TCP cluster under executor
     ``kind``: LIVE_NODES real Nodes (serializer threads, real sockets,
     on-disk WAL/reqstore with real fsyncs plus the emulated flush-latency
     floor), one consumer thread per node driving ``build_processor(kind)``,
     measured from first proposal until any node has committed
-    LIVE_TARGET_COMMITS requests."""
+    LIVE_TARGET_COMMITS requests.
+
+    ``flood_copies`` > 0 turns the client seam hostile: every submission
+    is delivered (1 + copies) times to every node — the paper's
+    request-duplication attack; dedup absorbs the echoes and the rung
+    prices what that costs.  With ``detailed`` the return value is
+    ``(rate, p95_commit_ms, flooded)`` — per-request commit latency from
+    first submission to first commit on the winning node — instead of the
+    bare rate."""
     import shutil
     import tempfile
 
@@ -640,16 +660,24 @@ def live_cluster_rate(kind: str) -> float:
             for req_no in range(LIVE_REQS_PER_CLIENT)
         }
 
+        propose_times: dict = {}  # first-submission instants
+        flood_count = [0]
+
         def propose(pending):
             for client_id, req_no in sorted(pending):
                 request = pb.Request(
                     client_id=client_id, req_no=req_no, data=b"%d" % req_no
                 )
+                propose_times.setdefault(
+                    (client_id, req_no), time.perf_counter()
+                )
                 for node in nodes:
-                    try:
-                        node.propose(request)
-                    except (NodeStopped, ValueError):
-                        pass
+                    for _copy in range(1 + flood_copies):
+                        try:
+                            node.propose(request)
+                        except (NodeStopped, ValueError):
+                            pass
+                    flood_count[0] += flood_copies
 
         start = time.perf_counter()
         deadline = start + LIVE_DEADLINE_S
@@ -676,7 +704,21 @@ def live_cluster_rate(kind: str) -> float:
                 f"commits within {LIVE_DEADLINE_S:.0f}s "
                 f"(per-node commits: {commits})"
             )
-        return LIVE_TARGET_COMMITS / elapsed
+        rate = LIVE_TARGET_COMMITS / elapsed
+        if not detailed:
+            return rate
+        winner = max(logs, key=lambda l: len(l.commits))
+        latencies = sorted(
+            1e3 * (when - propose_times[key])
+            for key, when in winner.commit_times.items()
+            if key in propose_times
+        )
+        p95_ms = (
+            latencies[min(len(latencies) - 1, int(0.95 * len(latencies)))]
+            if latencies
+            else None
+        )
+        return rate, p95_ms, flood_count[0]
     finally:
         stop.set()
         for thread in threads:
@@ -694,6 +736,21 @@ def live_cluster_rate(kind: str) -> float:
         for store in stores:
             store.close()
         shutil.rmtree(root, ignore_errors=True)
+
+
+def live_attack_run():
+    """Clean-vs-flood A/B on the live TCP cluster: the same serial
+    executor first under honest clients, then under a client-seam
+    duplication flood (every submission delivered 1+LIVE_ATTACK_COPIES
+    times to every node — the Mir paper's request-duplication attack).
+    Both halves record per-commit latency, so the rung prices the dedup
+    tax in goodput *and* tail latency rather than just surviving the
+    flood (the chaos campaign owns the correctness half)."""
+    clean_rate, clean_p95, _ = live_cluster_rate("serial", detailed=True)
+    attack_rate, attack_p95, flooded = live_cluster_rate(
+        "serial", flood_copies=LIVE_ATTACK_COPIES, detailed=True
+    )
+    return clean_rate, clean_p95, attack_rate, attack_p95, flooded
 
 
 def live_mp_run(kind: str):
@@ -960,6 +1017,14 @@ def main() -> int:
     live_pipelined = runner.run(
         "live_pipelined", lambda: live_cluster_rate("pipelined")
     )
+    attack = runner.run("live_under_attack", live_attack_run)
+    (
+        attack_clean_rate,
+        attack_clean_p95,
+        attack_rate,
+        attack_p95,
+        attack_flooded,
+    ) = attack if attack is not None else (None,) * 5
     mp_serial = runner.run("live_mp_serial", lambda: live_mp_run("serial"))
     mp_pipelined = runner.run(
         "live_mp_pipelined", lambda: live_mp_run("pipelined")
@@ -1078,6 +1143,26 @@ def main() -> int:
             f"batch_size={LIVE_BATCH_SIZE}, loopback TCP, on-disk "
             "WAL/reqstore, emulated flush latency "
             f"{LIVE_FSYNC_FLOOR_S * 1e3:.0f}ms/fsync"
+        ),
+        # Attack rung: the duplication-flood A/B — goodput and commit
+        # p95 under 4x client-seam duplication vs a clean baseline run
+        # in the same stage; `obsv --diff` gates these top-level numbers
+        # run-to-run like any other headline metric.
+        "live_attack_goodput_per_sec": _round(attack_rate),
+        "live_attack_commit_p95_ms": _round(attack_p95, 2),
+        "live_attack_clean_goodput_per_sec": _round(attack_clean_rate),
+        "live_attack_clean_commit_p95_ms": _round(attack_clean_p95, 2),
+        "live_attack_goodput_ratio": (
+            round(attack_rate / attack_clean_rate, 3)
+            if attack_rate and attack_clean_rate
+            else None
+        ),
+        "live_attack_flooded_submissions": attack_flooded,
+        "live_attack_config": (
+            f"duplication flood: every submission x{1 + LIVE_ATTACK_COPIES} "
+            f"to every node, serial executor, same cluster shape as "
+            "live_config; p95 is first-submission to first-commit on the "
+            "winning node"
         ),
         # Multi-process rung: real worker processes under stepped
         # open-loop Poisson load; headline numbers are the top rate

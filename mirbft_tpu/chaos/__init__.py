@@ -42,12 +42,17 @@ from .invariants import (
     CrashSnapshot,
     InvariantViolation,
     check_bounded_recovery,
+    check_censorship_liveness,
     check_commit_resumption,
+    check_corruption_rejected,
     check_durable_prefix,
+    check_flood_bounded,
     check_full_convergence,
     check_no_fork,
+    check_no_fork_under_equivocation,
 )
 from .live import (
+    AdversaryProxy,
     DurableChainLog,
     LiveCluster,
     PartitionProxy,
@@ -56,12 +61,18 @@ from .live import (
 )
 from .runner import CampaignResult, ScenarioResult, run_campaign, run_scenario
 from .scenarios import (
+    ADVERSARY_SMOKE_NAMES,
+    LIVE_ADVERSARY_NAMES,
     LIVE_SMOKE_NAMES,
     SMOKE_NAMES,
+    Adversary,
     CrashPoint,
     PartitionWindow,
     Scenario,
     StorageFault,
+    adversary_matrix,
+    adversary_smoke_matrix,
+    live_adversary_matrix,
     live_matrix,
     live_smoke_matrix,
     matrix,
@@ -69,6 +80,9 @@ from .scenarios import (
 )
 
 __all__ = [
+    "ADVERSARY_SMOKE_NAMES",
+    "Adversary",
+    "AdversaryProxy",
     "CampaignResult",
     "CrashPoint",
     "CrashSnapshot",
@@ -76,6 +90,7 @@ __all__ = [
     "FlakyDigestBackend",
     "FlakyVerifierBackend",
     "InvariantViolation",
+    "LIVE_ADVERSARY_NAMES",
     "LIVE_SMOKE_NAMES",
     "LiveCluster",
     "PartitionProxy",
@@ -84,11 +99,18 @@ __all__ = [
     "ScenarioResult",
     "SMOKE_NAMES",
     "StorageFault",
+    "adversary_matrix",
+    "adversary_smoke_matrix",
     "check_bounded_recovery",
+    "check_censorship_liveness",
     "check_commit_resumption",
+    "check_corruption_rejected",
     "check_durable_prefix",
+    "check_flood_bounded",
     "check_full_convergence",
     "check_no_fork",
+    "check_no_fork_under_equivocation",
+    "live_adversary_matrix",
     "live_matrix",
     "live_smoke_matrix",
     "matrix",

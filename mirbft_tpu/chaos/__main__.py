@@ -1,12 +1,14 @@
 """CLI entry: ``python -m mirbft_tpu.chaos [--seed N] [--seeds K] [--smoke]
-[--live] [--cluster {threads,mp}] [--only S]``.
+[--live] [--adversary] [--cluster {threads,mp}] [--only S]``.
 
 ``--live`` runs the campaign against a real loopback TCP cluster
 instead of the deterministic testengine; ``--smoke`` selects each
-mode's tier-1 subset.  ``--cluster`` picks the live cluster shape:
-``threads`` (default, chaos/live.py — every node in this process) or
-``mp`` (cluster/chaos_mp.py — one OS process per node, SIGKILL
-crashes, restart-from-disk, socket-proxy partitions).
+mode's tier-1 subset; ``--adversary`` swaps in the Byzantine matrix
+(corrupting, equivocating, censoring, and flooding leaders) on either
+engine.  ``--cluster`` picks the live cluster shape: ``threads``
+(default, chaos/live.py — every node in this process) or ``mp``
+(cluster/chaos_mp.py — one OS process per node, SIGKILL crashes,
+restart-from-disk, socket-proxy partitions).
 
 Exit status 0 iff every selected scenario passed all invariants (under
 every seed of the sweep, when ``--seeds`` > 1)."""
@@ -18,7 +20,15 @@ import sys
 
 from .live import run_live_campaign
 from .runner import run_campaign
-from .scenarios import live_matrix, live_smoke_matrix, matrix, smoke_matrix
+from .scenarios import (
+    adversary_matrix,
+    adversary_smoke_matrix,
+    live_adversary_matrix,
+    live_matrix,
+    live_smoke_matrix,
+    matrix,
+    smoke_matrix,
+)
 
 
 def main(argv=None) -> int:
@@ -47,6 +57,13 @@ def main(argv=None) -> int:
         action="store_true",
         help="run against a real loopback TCP cluster (real nodes, "
         "sockets, fsyncs) instead of the deterministic testengine",
+    )
+    parser.add_argument(
+        "--adversary",
+        action="store_true",
+        help="run the Byzantine adversary matrix (corrupting, "
+        "equivocating, censoring, and flooding leaders) instead of the "
+        "crash/partition fault matrix",
     )
     parser.add_argument(
         "--cluster",
@@ -86,11 +103,18 @@ def main(argv=None) -> int:
     if args.live and args.cluster == "mp":
         # The mp matrix is already the smoke-sized pair + the dedup
         # storm; process-per-node runs are too heavy for a long matrix.
-        from ..cluster.chaos_mp import mp_matrix
+        from ..cluster.chaos_mp import mp_adversary_matrix, mp_matrix
 
-        scenarios = mp_matrix()
+        scenarios = mp_adversary_matrix() if args.adversary else mp_matrix()
     elif args.live:
-        scenarios = live_smoke_matrix() if args.smoke else live_matrix()
+        if args.adversary:
+            scenarios = live_adversary_matrix()
+        else:
+            scenarios = live_smoke_matrix() if args.smoke else live_matrix()
+    elif args.adversary:
+        scenarios = (
+            adversary_smoke_matrix() if args.smoke else adversary_matrix()
+        )
     else:
         scenarios = smoke_matrix() if args.smoke else matrix()
     if args.only:

@@ -126,6 +126,149 @@ def check_commit_resumption(
         )
 
 
+def check_no_fork_under_equivocation(
+    rec, variants: dict, expect_suspicion: bool = False, base_epoch: int = 1
+) -> dict:
+    """The equivocating leader never forked the log: for every (epoch, seq)
+    where victims received a conflicting Preprepare, at most one of the two
+    batches committed anywhere — asserted via the per-seq content audit
+    plus app-chain agreement (the chain hashes the committed digests, so a
+    victim committing the variant batch would diverge even though its
+    (client, req_no) pairs match the real one).  ``variants`` is the
+    equivocate mangler's {(epoch, seq): (real, variant)} evidence; an empty
+    map means the adversary never fired and the scenario proves nothing.
+    With ``expect_suspicion`` the liar must also have been rotated out
+    (the honest quorum suspected it and changed epochs)."""
+    if not variants:
+        raise InvariantViolation(
+            "equivocation scenario rewrote no Preprepares (vacuous)"
+        )
+    canonical = check_no_fork(rec)
+    live = [n for n in range(rec.node_count) if not rec.node_states[n].crashed]
+    chains = {rec.node_states[n].app_chain for n in live}
+    if len(chains) != 1:
+        raise InvariantViolation(
+            f"app chains diverge under equivocation ({len(chains)} distinct):"
+            f" a victim committed the variant batch"
+        )
+    if expect_suspicion:
+        # base_epoch is the epoch every run negotiates at boot (the seed
+        # WAL's FEntry ends epoch 0) — suspicion evidence means moving
+        # beyond it.
+        epochs = [
+            rec.machines[n].epoch_tracker.current_epoch.number for n in live
+        ]
+        if max(epochs) <= base_epoch:
+            raise InvariantViolation(
+                "equivocating leader was never suspected: no epoch change "
+                f"(epochs {epochs}) despite {len(variants)} equivocated seqs"
+            )
+    return canonical
+
+
+def check_censorship_liveness(
+    rec,
+    censored_pairs: set,
+    commit_epochs: dict,
+    k: int,
+    expect_rotation: bool = True,
+) -> None:
+    """Censorship is defeated by bucket rotation: every (client_id, req_no)
+    the leader censored still committed, and did so within ``k`` epoch
+    rotations.  ``commit_epochs`` maps each censored pair to the rotation
+    count (epochs beyond the first working epoch) observed when it first
+    committed anywhere, collected by the runner as commits land.  With
+    ``expect_rotation`` at least one censored request must have *needed* a
+    rotation — otherwise the censor never owned a victim bucket and the
+    scenario proves nothing."""
+    if not censored_pairs:
+        raise InvariantViolation(
+            "censorship scenario suppressed no requests (vacuous)"
+        )
+    missing = sorted(
+        pair
+        for pair in censored_pairs
+        if pair[1] not in rec.clients[pair[0]].committed_anywhere
+    )
+    if missing:
+        raise InvariantViolation(
+            f"censored requests never committed: {missing[:10]}"
+            f"{'...' if len(missing) > 10 else ''}"
+        )
+    late = sorted(
+        (pair, epoch)
+        for pair, epoch in commit_epochs.items()
+        if epoch > k
+    )
+    if late:
+        raise InvariantViolation(
+            f"censored requests took more than {k} epoch rotations to "
+            f"commit: {late[:10]}"
+        )
+    if expect_rotation and (
+        not commit_epochs or max(commit_epochs.values()) < 1
+    ):
+        raise InvariantViolation(
+            "no censored request needed an epoch rotation to commit — the "
+            "censoring leader never owned a victim bucket (vacuous scenario)"
+        )
+
+
+def check_corruption_rejected(rejections: int, corrupted: int) -> None:
+    """Signed mode rejects 100% of in-flight corruptions: every proposal
+    delivery the adversary rewrote was refused at ingress authentication —
+    no more (honest traffic passes) and no fewer (nothing slips through).
+    Engine-agnostic: the deterministic runner passes the Recorder's
+    ``byzantine_rejections``, the live driver its gate counter."""
+    if corrupted <= 0:
+        raise InvariantViolation(
+            "corruption scenario rewrote no proposals (vacuous)"
+        )
+    if rejections != corrupted:
+        raise InvariantViolation(
+            f"signed mode rejected {rejections} of {corrupted} corrupted "
+            "proposal deliveries"
+        )
+
+
+def check_flood_bounded(
+    rec, flooded: int, wal_bound: int | None = None
+) -> None:
+    """Duplication/stale-ack floods are absorbed: every request still
+    committed exactly once per node, the request store holds at most one
+    entry per distinct request (echoes deduplicated, no unbounded memory),
+    and the WAL stayed within its checkpoint-truncation envelope (no
+    unbounded disk).  ``flooded`` is the adversary's echo count; zero means
+    the flood never fired and the scenario proves nothing."""
+    if flooded <= 0:
+        raise InvariantViolation("flood scenario injected no echoes (vacuous)")
+    total = sum(c.total_reqs for c in rec.clients.values())
+    if wal_bound is None:
+        ci = rec.initial_state.config.checkpoint_interval
+        # Post-truncation WAL retains the entries above the last stable
+        # checkpoint: up to ~2 in-flight checkpoint windows of QEntry+PEntry
+        # pairs plus epoch-change records.
+        wal_bound = 10 * ci + 8 * rec.node_count + 64
+    for node in range(rec.node_count):
+        state = rec.node_states[node]
+        pairs = [(c, q) for c, q, _s in state.committed_reqs]
+        if len(pairs) != len(set(pairs)):
+            dupes = sorted({p for p in pairs if pairs.count(p) > 1})
+            raise InvariantViolation(
+                f"flood broke exactly-once at node {node}: {dupes[:10]}"
+            )
+        if len(state.reqstore) > total:
+            raise InvariantViolation(
+                f"flood grew node {node}'s request store to "
+                f"{len(state.reqstore)} entries for {total} distinct requests"
+            )
+        if len(state.wal) > wal_bound:
+            raise InvariantViolation(
+                f"flood grew node {node}'s WAL to {len(state.wal)} entries "
+                f"(bound {wal_bound}): checkpoint truncation fell behind"
+            )
+
+
 def check_bounded_recovery(
     completion_ms: int, last_disruption_end_ms: int, bound_ms: int
 ) -> None:

@@ -17,12 +17,25 @@ from .invariants import (
     CrashSnapshot,
     InvariantViolation,
     check_bounded_recovery,
+    check_censorship_liveness,
     check_commit_resumption,
+    check_corruption_rejected,
     check_durable_prefix,
+    check_flood_bounded,
     check_full_convergence,
     check_no_fork,
+    check_no_fork_under_equivocation,
 )
 from .scenarios import Scenario, matrix
+
+# The boot WAL's FEntry gracefully ends epoch 0, so every run negotiates
+# epoch 1 at startup — epoch 1 *is* the quiescent baseline, and only an
+# epoch beyond it is evidence of a forced change / bucket rotation.
+FIRST_WORKING_EPOCH = 1
+
+# Rotations-to-commit scale for the censorship histogram (the default
+# obsv buckets are seconds — wrong scale for epoch counts).
+ROTATION_BUCKETS = (0, 1, 2, 3, 4, 6, 8)
 
 
 @dataclass
@@ -103,6 +116,9 @@ def run_scenario(
         hash_plane=hash_plane,
         signer=signer,
         signature_plane=signature_plane,
+        network_state=(
+            scenario.network_state() if scenario.network_state else None
+        ),
         record=False,
     )
 
@@ -111,6 +127,34 @@ def run_scenario(
     commit_times: list = []
     last_total = sum(rec._committed_counts.values())
     result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
+
+    censor_manglers = [m for m in manglers if hasattr(m, "censored_pairs")]
+    # (client_id, req_no) -> epoch rotations (relative to the first
+    # working epoch) observed when the censored request first committed
+    # anywhere; the censorship-liveness invariant's evidence.
+    commit_rotations: dict = {}
+
+    def current_rotation() -> int:
+        epochs = [
+            rec.machines[n].epoch_tracker.current_epoch.number
+            for n in range(rec.node_count)
+            if not rec.node_states[n].crashed
+            and rec.machines[n].epoch_tracker.current_epoch is not None
+        ]
+        return max(0, max(epochs, default=0) - FIRST_WORKING_EPOCH)
+
+    def track_censored_commits() -> None:
+        rotation = None
+        for mangler in censor_manglers:
+            for pair in mangler.censored_pairs:
+                if pair in commit_rotations:
+                    continue
+                client = rec.clients.get(pair[0])
+                if client is None or pair[1] not in client.committed_anywhere:
+                    continue
+                if rotation is None:
+                    rotation = current_rotation()
+                commit_rotations[pair] = rotation
 
     def fire_due_crashes() -> None:
         while pending and rec.now >= pending[0].at_ms:
@@ -153,6 +197,8 @@ def run_scenario(
             if total > last_total:
                 last_total = total
                 commit_times.append(rec.now)
+                if censor_manglers:
+                    track_censored_commits()
         else:
             raise InvariantViolation(
                 f"no convergence after {scenario.max_steps} steps "
@@ -186,11 +232,17 @@ def run_scenario(
                 for n in range(rec.node_count)
             ]
             result.counters["epoch"] = max(epochs)
-            if max(epochs) < 1:
+            # Every run negotiates FIRST_WORKING_EPOCH at boot (the seed
+            # WAL's FEntry ends epoch 0), so reaching it is not evidence
+            # of a change — the cluster must have moved *beyond* it.
+            if max(epochs) <= FIRST_WORKING_EPOCH:
                 raise InvariantViolation(
-                    "scenario expected an epoch change but every node "
-                    "is still in epoch 0"
+                    "scenario expected an epoch change but every node is "
+                    f"still in the boot epoch (epochs {epochs})"
                 )
+        _audit_adversaries(
+            scenario, rec, manglers, commit_rotations, registry, result
+        )
         result.passed = True
     except InvariantViolation as violation:
         result.violation = str(violation)
@@ -231,6 +283,55 @@ def run_scenario(
         result.counters["sig_fallbacks"] = signature_plane.fallback_verifies
         result.counters["sig_breaker"] = signature_plane.breaker.state
     return result
+
+
+def _audit_adversaries(
+    scenario, rec, manglers, commit_rotations, registry, result
+) -> None:
+    """Run the Byzantine invariants for whichever adversarial manglers the
+    scenario carried (attribute-sniffed, so raw-DSL scenarios are audited
+    identically to structured Adversary specs).  Raises
+    InvariantViolation; also folds attack evidence into the result
+    counters and the obsv registry."""
+    corrupted = sum(getattr(m, "corrupted", 0) for m in manglers)
+    corrupted_proposes = sum(
+        getattr(m, "corrupted_proposes", 0) for m in manglers
+    )
+    flooded = sum(getattr(m, "flooded", 0) for m in manglers)
+    censored = sum(getattr(m, "censored", 0) for m in manglers)
+    variants: dict = {}
+    for m in manglers:
+        variants.update(getattr(m, "variants", {}))
+    censored_pairs: set = set()
+    for m in manglers:
+        censored_pairs |= getattr(m, "censored_pairs", set())
+
+    if corrupted:
+        result.counters["corrupted"] = corrupted
+    if scenario.signed and corrupted_proposes:
+        result.counters["rejections"] = rec.byzantine_rejections
+        check_corruption_rejected(rec.byzantine_rejections, corrupted_proposes)
+    if variants:
+        result.counters["equivocated"] = len(variants)
+        check_no_fork_under_equivocation(
+            rec, variants, expect_suspicion=scenario.expect_epoch_change
+        )
+    if any(hasattr(m, "censored_pairs") for m in manglers):
+        result.counters["censored"] = censored
+        k = scenario.notes.get("censor_k", 3)
+        check_censorship_liveness(rec, censored_pairs, commit_rotations, k)
+        rotations = list(commit_rotations.values())
+        result.counters["rotations_max"] = max(rotations, default=0)
+        histogram = registry.histogram(
+            "mirbft_censored_commit_epochs",
+            buckets=ROTATION_BUCKETS,
+            scenario=scenario.name,
+        )
+        for rotation in rotations:
+            histogram.observe(rotation)
+    if any(hasattr(m, "flooded") for m in manglers):
+        result.counters["flooded"] = flooded
+        check_flood_bounded(rec, flooded)
 
 
 def run_campaign(

@@ -25,13 +25,19 @@ from dataclasses import dataclass, field
 
 from ..resilience import CircuitBreaker
 from ..testengine.crypto_plane import CoalescingHashPlane
+from ..testengine.engine import standard_initial_network_state
 from ..testengine.manglers import (
+    after_time,
+    from_client,
     from_source,
+    is_propose,
     is_step,
     msg_type,
     partition,
     percent,
     rule,
+    to_node,
+    until_time,
 )
 from ..testengine.signing import SignaturePlane
 from .faults import FlakyDigestBackend, FlakyVerifierBackend
@@ -72,6 +78,109 @@ class StorageFault:
     restart_delay_ms: int
 
 
+@dataclass(frozen=True)
+class Adversary:
+    """Engine-agnostic Byzantine attack: a compromised node (or link)
+    attacking *content and ordering* rather than delivery — the malicious-
+    leader model of the Mir paper's robustness evaluation.  The
+    deterministic runner lowers each spec onto the adversarial mangler
+    actions (``lower()``); the live driver lowers the same spec onto
+    frame-rewriting socket proxies and the signed ingress gate.
+
+    Kinds:
+
+    * ``corrupt`` — flip ``byte_flips`` bytes of matched payloads/digests
+      in flight.  ``msg_kinds=("Propose",)`` attacks client proposals
+      (signed mode must reject 100%); other kinds name wire messages.
+      ``victims`` restricts to deliveries into those nodes (empty = all).
+    * ``equivocate`` — ``node`` (a leader) sends conflicting Preprepares
+      for the same (epoch, seq) to the ``victims`` follower subset.
+    * ``censor`` — ``node`` silently drops every event speaking for the
+      ``victims`` client ids at its ingress (proposals, acks, forwards);
+      defeated by epoch-rotation of bucket assignment.
+    * ``flood`` — ``copies`` delayed echoes of matched messages spread
+      over ``stale_delay_ms`` (duplication / stale-ack storms against the
+      dedup path).  ``msg_kinds=("Propose",)`` storms client submissions.
+    """
+
+    kind: str  # "corrupt" | "equivocate" | "censor" | "flood"
+    # The compromised node.  For corrupt/flood over wire messages it
+    # scopes from_source; -1 means any source (a compromised network
+    # rather than a compromised node).  Corrupting RequestAcks from more
+    # than f sources exceeds Mir's threat model: ack integrity is a
+    # signature property, so in-flight ack corruption models a *lying
+    # acker*, and the one-vote-per-node rule rightly wedges availability
+    # past f liars.
+    node: int = 0
+    victims: tuple = ()  # nodes (equivocate/corrupt) or client ids (censor)
+    from_ms: int = 0
+    until_ms: int | None = None  # None = attacks for the whole run
+    rate_pct: int = 100
+    byte_flips: int = 1  # corrupt
+    msg_kinds: tuple = ("Propose",)  # corrupt/flood surface
+    copies: int = 2  # flood echoes per matched message
+    stale_delay_ms: int = 4000  # flood echo spread
+
+    def lower(self):
+        """Build the testengine mangler for this attack (fresh state per
+        call; all randomness seeded via the recorder)."""
+        window = []
+        if self.from_ms:
+            window.append(after_time(self.from_ms))
+        if self.until_ms is not None:
+            window.append(until_time(self.until_ms))
+        # percent() burns an rng draw per candidate it sees; keep it last
+        # so only events the cheap predicates matched consume randomness.
+        gate = [percent(self.rate_pct)] if self.rate_pct < 100 else []
+        if self.kind == "corrupt":
+            if self.msg_kinds == ("Propose",):
+                base = [is_propose()]
+            else:
+                base = [msg_type(*self.msg_kinds)]
+                if self.node >= 0:
+                    base.append(from_source(self.node))
+            if self.victims:
+                base.append(to_node(*self.victims))
+            return rule(*base, *window, *gate).corrupt(self.byte_flips)
+        if self.kind == "equivocate":
+            return rule(
+                msg_type("Preprepare"), from_source(self.node), *window, *gate
+            ).equivocate(self.victims)
+        if self.kind == "censor":
+            return rule(
+                to_node(self.node), from_client(*self.victims), *window
+            ).censor()
+        if self.kind == "flood":
+            if self.msg_kinds == ("Propose",):
+                base = [is_propose()]
+            else:
+                base = [msg_type(*self.msg_kinds)]
+                if self.node >= 0:
+                    base.append(from_source(self.node))
+            return rule(*base, *window, *gate).flood(
+                self.copies, self.stale_delay_ms
+            )
+        raise ValueError(f"unknown adversary kind {self.kind!r}")
+
+
+def _rotating_network_state(
+    node_count: int = 4,
+    client_ids: tuple = (4, 5),
+    max_epoch_length: int = 40,
+):
+    """Factory for a network state with a short planned epoch length, so
+    graceful bucket rotation — the paper's anti-censorship defense —
+    happens within a scenario run instead of after the default 10
+    checkpoint windows."""
+
+    def build():
+        state = standard_initial_network_state(node_count, list(client_ids))
+        state.config.max_epoch_length = max_epoch_length
+        return state
+
+    return build
+
+
 @dataclass
 class Scenario:
     name: str
@@ -93,9 +202,15 @@ class Scenario:
     # ingress through a SignaturePlane (factory below, fresh per run).
     signed: bool = False
     signature_plane: object = None  # zero-arg factory (signed mode)
+    # Byzantine attacks (Adversary specs); both engines lower them.
+    adversaries: tuple = ()
     # The scenario is designed to force an epoch change; the runner
-    # fails it unless every surviving node ends in an epoch >= 1.
+    # fails it unless some node ends beyond the first working epoch.
     expect_epoch_change: bool = False
+    # Zero-arg factory -> initial NetworkState (overrides the standard
+    # one; censorship scenarios shorten max_epoch_length so bucket
+    # rotation lands inside the run).
+    network_state: object = None
     # Zero-arg factory -> hash plane (fresh breaker/counters per run).
     hash_plane: object = None
     # Heal instants (ms) of disruptions the raw manglers inject;
@@ -128,6 +243,8 @@ class Scenario:
             )
         if self.drop_pct:
             built.append(rule(is_step(), percent(self.drop_pct)).drop())
+        for adversary in self.adversaries:
+            built.append(adversary.lower())
         if self.manglers:
             built.extend(self.manglers())
         return built
@@ -305,6 +422,202 @@ def matrix() -> list:
             expect_epoch_change=True,
             tags=("epoch", "live"),
         ),
+        # -- Byzantine adversary campaign (malicious leaders/links) -------
+        Scenario(
+            name="corrupt-propose-signed",
+            description="60% of proposal deliveries into nodes 1 and 2 "
+            "are bit-flipped in flight; signed ingress must reject every "
+            "corruption while the honest copies (nodes 0 and 3 always "
+            "reach weak quorum) and the fetch machinery still commit all",
+            signed=True,
+            reqs_per_client=12,
+            # Victims are capped at f+1 nodes so every request keeps a
+            # weak quorum of honest copies: the engine's clients never
+            # resubmit, so a proposal corrupted at 2f+1 ingresses would be
+            # indistinguishable from one never sent.
+            adversaries=(
+                Adversary(kind="corrupt", victims=(1, 2), rate_pct=60),
+            ),
+            tags=("adversary", "signed", "live"),
+        ),
+        Scenario(
+            name="corrupt-digests-in-flight",
+            description="node 1 lies in 60% of its request acks while 15% "
+            "of Prepare/Commit digests from anywhere are bit-flipped for "
+            "5s; ack lying stays within f sources (ack integrity is a "
+            "signature property, so >f lying ackers exceeds the threat "
+            "model) and quorum redundancy must absorb it all without "
+            "forking",
+            adversaries=(
+                Adversary(
+                    kind="corrupt",
+                    node=1,
+                    msg_kinds=("RequestAck",),
+                    rate_pct=60,
+                    until_ms=5000,
+                ),
+                Adversary(
+                    kind="corrupt",
+                    node=-1,
+                    msg_kinds=("Prepare", "Commit"),
+                    rate_pct=15,
+                    until_ms=5000,
+                ),
+            ),
+            heal_points_ms=(5000,),
+            tags=("adversary",),
+        ),
+        Scenario(
+            name="corrupt-forwarded-data",
+            description="half the proposal deliveries into nodes 2 and 3 "
+            "are lost, forcing data fetches — and 40% of the resulting "
+            "ForwardRequests carry corrupted payloads the receiver's "
+            "digest re-verification must drop and refetch",
+            adversaries=(
+                Adversary(
+                    kind="corrupt",
+                    node=-1,
+                    msg_kinds=("ForwardRequest",),
+                    rate_pct=40,
+                ),
+            ),
+            manglers=lambda: [
+                rule(is_propose(), to_node(2, 3), percent(50)).drop()
+            ],
+            tags=("adversary",),
+        ),
+        Scenario(
+            name="equivocate-majority-suspect",
+            description="leader 0 sends conflicting Preprepares to "
+            "followers 1 and 2 for 3s; no digest can reach quorum, so the "
+            "honest nodes must suspect the liar and change epochs — "
+            "committing every sequence exactly once",
+            adversaries=(
+                Adversary(
+                    kind="equivocate", node=0, victims=(1, 2), until_ms=3000
+                ),
+            ),
+            expect_epoch_change=True,
+            heal_points_ms=(3000,),
+            tags=("adversary", "epoch"),
+        ),
+        Scenario(
+            name="equivocate-minority-straggler",
+            description="leader 0 lies only to follower 3 for 4s; the "
+            "honest majority keeps committing and the victim must catch "
+            "up (retransmission/state transfer) without ever committing "
+            "the variant batch",
+            reqs_per_client=20,
+            adversaries=(
+                Adversary(
+                    kind="equivocate", node=0, victims=(3,), until_ms=4000
+                ),
+            ),
+            heal_points_ms=(4000,),
+            tags=("adversary", "live"),
+        ),
+        Scenario(
+            name="censor-client-rotation",
+            description="leader 0 silently drops everything client 4 "
+            "submits (proposals, acks, forwards at its ingress) for 10s; "
+            "short epochs force bucket rotation, which must hand the "
+            "censored bucket to an honest leader within k rotations",
+            adversaries=(
+                Adversary(
+                    kind="censor", node=0, victims=(4,), until_ms=10_000
+                ),
+            ),
+            network_state=_rotating_network_state(max_epoch_length=40),
+            heal_points_ms=(10_000,),
+            notes={"censor_k": 3},
+            tags=("adversary", "censor", "live"),
+        ),
+        Scenario(
+            name="censor-both-clients",
+            description="leader 0 censors both clients at once for 10s — "
+            "every bucket it owns starves until rotation rescues them",
+            adversaries=(
+                Adversary(
+                    kind="censor", node=0, victims=(4, 5), until_ms=10_000
+                ),
+            ),
+            network_state=_rotating_network_state(max_epoch_length=40),
+            heal_points_ms=(10_000,),
+            notes={"censor_k": 3},
+            tags=("adversary", "censor"),
+        ),
+        Scenario(
+            name="flood-stale-acks",
+            description="half of node 1's RequestAcks are echoed 3x up to "
+            "8s late — stale acks for long-committed requests that the "
+            "client windows must shrug off",
+            adversaries=(
+                Adversary(
+                    kind="flood",
+                    node=1,
+                    msg_kinds=("RequestAck",),
+                    copies=3,
+                    stale_delay_ms=8000,
+                    rate_pct=50,
+                ),
+            ),
+            tags=("adversary", "flood"),
+        ),
+        Scenario(
+            name="flood-duplicate-proposes",
+            description="75% of client submissions are delivered 4x (the "
+            "paper's request-duplication attack); dedup must commit "
+            "exactly once with bounded store growth",
+            adversaries=(
+                Adversary(
+                    kind="flood",
+                    msg_kinds=("Propose",),
+                    copies=3,
+                    stale_delay_ms=2000,
+                    rate_pct=75,
+                ),
+            ),
+            tags=("adversary", "flood", "live"),
+        ),
+        Scenario(
+            name="flood-threephase-storm",
+            description="node 0's Preprepare/Prepare/Commit traffic is "
+            "doubled with echoes up to 3s late; consensus dedup must "
+            "hold watermarks and WAL growth bounded",
+            adversaries=(
+                Adversary(
+                    kind="flood",
+                    node=0,
+                    msg_kinds=("Preprepare", "Prepare", "Commit"),
+                    copies=2,
+                    stale_delay_ms=3000,
+                    rate_pct=50,
+                ),
+            ),
+            tags=("adversary", "flood"),
+        ),
+        Scenario(
+            name="equivocate-plus-flood",
+            description="leader 0 equivocates to followers 1 and 2 while "
+            "node 2's acks are storm-echoed — the epoch change must land "
+            "despite the noise",
+            adversaries=(
+                Adversary(
+                    kind="equivocate", node=0, victims=(1, 2), until_ms=4000
+                ),
+                Adversary(
+                    kind="flood",
+                    node=2,
+                    msg_kinds=("RequestAck",),
+                    copies=2,
+                    stale_delay_ms=5000,
+                    rate_pct=40,
+                ),
+            ),
+            expect_epoch_change=True,
+            heal_points_ms=(4000,),
+            tags=("adversary", "epoch", "flood"),
+        ),
         Scenario(
             name="signed-verifier-dies",
             description="signed mode: the signature device raises "
@@ -328,6 +641,26 @@ SMOKE_NAMES = ("partition-minority", "crash-restart", "device-digest-dies")
 def smoke_matrix() -> list:
     by_name = {s.name: s for s in matrix()}
     return [by_name[name] for name in SMOKE_NAMES]
+
+
+def adversary_matrix() -> list:
+    """The Byzantine subset of the matrix (corrupt / equivocate / censor /
+    flood attacks), selected by ``chaos --adversary``."""
+    return [s for s in matrix() if "adversary" in s.tags]
+
+
+# The tier-1 adversary smoke: one equivocation forcing suspicion + epoch
+# change, one duplication flood against the dedup path — the two attack
+# families with the richest invariants, cheap enough for tier-1.
+ADVERSARY_SMOKE_NAMES = (
+    "equivocate-majority-suspect",
+    "flood-duplicate-proposes",
+)
+
+
+def adversary_smoke_matrix() -> list:
+    by_name = {s.name: s for s in matrix()}
+    return [by_name[name] for name in ADVERSARY_SMOKE_NAMES]
 
 
 def live_matrix() -> list:
@@ -362,3 +695,19 @@ LIVE_SMOKE_NAMES = ("crash-restart", "partition-minority")
 def live_smoke_matrix() -> list:
     by_name = {s.name: s for s in live_matrix()}
     return [by_name[name] for name in LIVE_SMOKE_NAMES]
+
+
+# The live adversary campaign (`chaos --live --adversary`): the shared
+# structured Adversary scenarios the live driver can lower onto its
+# frame-rewriting proxies and the signed ingress gate.
+LIVE_ADVERSARY_NAMES = (
+    "corrupt-propose-signed",
+    "equivocate-minority-straggler",
+    "censor-client-rotation",
+    "flood-duplicate-proposes",
+)
+
+
+def live_adversary_matrix() -> list:
+    by_name = {s.name: s for s in matrix()}
+    return [by_name[name] for name in LIVE_ADVERSARY_NAMES]

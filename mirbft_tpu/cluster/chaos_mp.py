@@ -29,6 +29,7 @@ rejected rather than silently weakened.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from types import SimpleNamespace
@@ -76,6 +77,29 @@ def mp_matrix() -> list:
     ]
 
 
+def mp_adversary_matrix() -> list:
+    """The mp lowering of the adversary campaign: attacks driven at the
+    client seam (duplication floods through real submission sockets).
+    Wire-level adversaries need the threads cluster's frame-rewriting
+    proxies and are rejected here."""
+    from ..chaos.scenarios import live_adversary_matrix
+
+    return [
+        scenario
+        for scenario in live_adversary_matrix()
+        if _mp_supported_adversaries(scenario)
+        and not scenario.signed
+        and scenario.network_state is None
+    ]
+
+
+def _mp_supported_adversaries(scenario: Scenario) -> bool:
+    return bool(scenario.adversaries) and all(
+        spec.kind == "flood" and spec.msg_kinds == ("Propose",)
+        for spec in scenario.adversaries
+    )
+
+
 def _reject_unsupported(scenario: Scenario) -> None:
     unsupported = []
     if scenario.storage_faults:
@@ -84,6 +108,8 @@ def _reject_unsupported(scenario: Scenario) -> None:
         unsupported.append("signed")
     if scenario.drop_pct:
         unsupported.append("drop_pct")
+    if scenario.adversaries and not _mp_supported_adversaries(scenario):
+        unsupported.append("non-flood adversaries")
     if unsupported:
         raise ValueError(
             f"scenario {scenario.name!r} uses {', '.join(unsupported)}, "
@@ -102,8 +128,15 @@ class _MpDriver:
         max_reqs_per_client: int,
         processor: str,
         retry_period_s: float = 0.3,
+        seed: int = 0,
     ):
         self.scenario = scenario
+        # Propose-flood adversaries lower to multiplied submissions
+        # through the real client sockets (seeded, windowed); everything
+        # else was rejected by _reject_unsupported.
+        self.flood_specs = list(scenario.adversaries)
+        self.flooded = 0
+        self._rng = random.Random(seed)
         self.tick_seconds = tick_seconds
         self.budget_s = budget_s
         self.reqs_per_client = min(
@@ -148,6 +181,28 @@ class _MpDriver:
 
     # -- client load ---------------------------------------------------------
 
+    def _flood_copies(self) -> int:
+        """Extra duplicate submissions the flood adversaries inject for
+        one delivery right now (0 when no window is open)."""
+        if self._start is None:
+            return 0
+        now_s = time.monotonic() - self._start
+        copies = 0
+        for spec in self.flood_specs:
+            if now_s < self.scale_s(spec.from_ms):
+                continue
+            if spec.until_ms is not None and now_s >= self.scale_s(
+                spec.until_ms
+            ):
+                continue
+            if (
+                spec.rate_pct < 100
+                and self._rng.random() * 100.0 >= spec.rate_pct
+            ):
+                continue
+            copies += spec.copies
+        return copies
+
     def _submit(self, client_id: int, req_no: int, first: bool) -> None:
         request = pb.Request(
             client_id=client_id, req_no=req_no, data=b"%d" % req_no
@@ -158,6 +213,10 @@ class _MpDriver:
                 self.supervisor.submit(node_id, request)
                 if not first or round_no > 0:
                     self.resubmissions += 1
+                copies = self._flood_copies() if self.flood_specs else 0
+                for _ in range(copies):
+                    self.supervisor.submit(node_id, request)
+                self.flooded += copies
 
     def _propose_all(self, last_event_s: float) -> None:
         ordered = sorted(self.expected)
@@ -319,7 +378,12 @@ def run_mp_scenario(
     _reject_unsupported(scenario)
     result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
     driver = _MpDriver(
-        scenario, tick_seconds, budget_s, max_reqs_per_client, processor
+        scenario,
+        tick_seconds,
+        budget_s,
+        max_reqs_per_client,
+        processor,
+        seed=seed,
     )
     try:
         try:
@@ -339,23 +403,35 @@ def run_mp_scenario(
             evidence = driver.evidence()
             check_no_fork(evidence)
             check_durable_prefix(evidence, driver.snapshots)
-            if scenario.name == "retry-storm-dedup":
-                if driver.resubmissions == 0:
+            if driver.flood_specs:
+                result.counters["flooded"] = driver.flooded
+                if driver.flooded <= 0:
+                    raise InvariantViolation(
+                        "flood scenario injected no duplicate submissions "
+                        "(vacuous)"
+                    )
+            if scenario.name == "retry-storm-dedup" or driver.flood_specs:
+                if (
+                    scenario.name == "retry-storm-dedup"
+                    and driver.resubmissions == 0
+                ):
                     raise InvariantViolation(
                         "the retry storm never submitted a duplicate — "
                         "the scenario proved nothing"
                     )
-                # Exactly-once, strictly: the storm must not inflate any
-                # node's log past one commit per unique request.
+                # Exactly-once, strictly: neither the storm nor the flood
+                # may inflate any node's log past one commit per unique
+                # request.
                 for state in evidence.node_states:
                     pairs = [(c, q) for c, q, _s in state.committed_reqs]
                     extra = len(pairs) - len(driver.expected)
                     if extra > 0:
                         raise InvariantViolation(
-                            f"retry storm leaked {extra} duplicate "
+                            f"duplicate storm leaked {extra} duplicate "
                             "commits into a node's log"
                         )
-                result.counters["resubmissions"] = driver.resubmissions
+                if scenario.name == "retry-storm-dedup":
+                    result.counters["resubmissions"] = driver.resubmissions
             result.passed = True
         except InvariantViolation as violation:
             result.violation = str(violation)

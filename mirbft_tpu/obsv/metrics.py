@@ -38,6 +38,8 @@ import threading
 # docs/OBSERVABILITY.md.
 CATALOG = {
     "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
+    "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/malformed).",
+    "mirbft_censored_commit_epochs": "Epoch rotations a censored-but-retried request needed before committing, per scenario.",
     "mirbft_chaos_dropped_total": "Messages dropped by chaos manglers, per scenario.",
     "mirbft_chaos_duplicated_total": "Messages duplicated by chaos manglers, per scenario.",
     "mirbft_chaos_live_recovery_ms": "Live chaos scenario: wall ms from the last heal/restart to convergence.",
@@ -76,6 +78,8 @@ CATALOG = {
 # docs test checks every label name below against docs/OBSERVABILITY.md).
 CATALOG_LABELS = {
     "mirbft_bench_stage_seconds": ("stage",),
+    "mirbft_byzantine_rejections_total": ("kind",),
+    "mirbft_censored_commit_epochs": ("scenario",),
     "mirbft_chaos_dropped_total": ("scenario",),
     "mirbft_chaos_duplicated_total": ("scenario",),
     "mirbft_chaos_live_recovery_ms": ("scenario",),

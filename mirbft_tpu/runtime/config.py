@@ -27,6 +27,12 @@ class Config:
     new_epoch_timeout_ticks: int = 8
     # Per-remote-node byte budget for buffered not-yet-applyable messages.
     buffer_size: int = 5 * 1024 * 1024
+    # Ingress frame bounds enforced by msgfilter.pre_process before a
+    # peer message enters the serializer; raise max_batch_acks together
+    # with batch_size when reconfiguring for larger batches.
+    max_batch_acks: int = 256
+    max_request_bytes: int = 1024 * 1024
+    max_digest_bytes: int = 64
     # Optional callable(state_event) invoked inside the serializer before
     # each event application (the tracing hook; see eventlog.Recorder).
     event_interceptor: object = None

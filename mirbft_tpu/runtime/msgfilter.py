@@ -4,25 +4,90 @@ Rebuild of the reference's preProcess (reference: msgfilter.go:18-105),
 run in the *caller's* thread by Node.step before the message enters the
 serializer.  The codec already rejects unset oneofs on decode; this guards
 required nested fields for messages constructed in-process or decoded from
-peers.
+peers, and bounds the variable-size fields so a flooding peer cannot ship
+arbitrarily large Preprepares, payloads, or digests past ingress.
 """
 
 from __future__ import annotations
 
 from .. import pb
 
+# Fallback bounds when the caller passes no Config (testengine paths).
+# Generous relative to honest traffic: batches are batch_size acks (cut
+# smaller on heartbeats), payloads are application requests, digests are
+# sha256 (32 bytes) — honest messages sit far below all three.
+_DEFAULT_MAX_BATCH_ACKS = 256
+_DEFAULT_MAX_REQUEST_BYTES = 1024 * 1024
+_DEFAULT_MAX_DIGEST_BYTES = 64
+
 
 class MalformedMessage(ValueError):
-    pass
+    """Preflight rejection.  ``kind`` labels the failure for the
+    ``mirbft_byzantine_rejections_total`` taxonomy: ``malformed``
+    (structural), ``oversized_batch``, ``oversized_payload``, or
+    ``oversized_digest``."""
+
+    def __init__(self, message: str, kind: str = "malformed"):
+        super().__init__(message)
+        self.kind = kind
 
 
-def pre_process(msg: pb.Msg) -> None:
+def _check_digest(digest: bytes, limit: int, what: str) -> None:
+    if len(digest) > limit:
+        raise MalformedMessage(
+            f"{what} digest is {len(digest)} bytes (max {limit})",
+            kind="oversized_digest",
+        )
+
+
+def _check_acks(acks, max_acks: int, max_digest: int, what: str) -> None:
+    if len(acks) > max_acks:
+        raise MalformedMessage(
+            f"{what} carries {len(acks)} acks (max {max_acks})",
+            kind="oversized_batch",
+        )
+    for ack in acks:
+        _check_digest(ack.digest, max_digest, f"{what} ack")
+
+
+def pre_process(msg: pb.Msg, limits=None) -> None:
+    """Validate structure and size bounds.  ``limits`` is a runtime
+    ``Config`` (or any object with ``max_batch_acks`` /
+    ``max_request_bytes`` / ``max_digest_bytes``); omitted attributes
+    fall back to the module defaults."""
+    max_acks = getattr(limits, "max_batch_acks", _DEFAULT_MAX_BATCH_ACKS)
+    max_payload = getattr(
+        limits, "max_request_bytes", _DEFAULT_MAX_REQUEST_BYTES
+    )
+    max_digest = getattr(
+        limits, "max_digest_bytes", _DEFAULT_MAX_DIGEST_BYTES
+    )
     inner = msg.type
     if inner is None:
         raise MalformedMessage("message has no type set")
     if isinstance(inner, pb.ForwardRequest):
         if inner.request_ack is None:
             raise MalformedMessage("ForwardRequest without request_ack")
+        _check_digest(
+            inner.request_ack.digest, max_digest, "ForwardRequest"
+        )
+        if len(inner.request_data) > max_payload:
+            raise MalformedMessage(
+                f"ForwardRequest payload is {len(inner.request_data)} "
+                f"bytes (max {max_payload})",
+                kind="oversized_payload",
+            )
+    elif isinstance(inner, pb.Preprepare):
+        _check_acks(inner.batch, max_acks, max_digest, "Preprepare")
+    elif isinstance(inner, pb.ForwardBatch):
+        _check_acks(
+            inner.request_acks, max_acks, max_digest, "ForwardBatch"
+        )
+        _check_digest(inner.digest, max_digest, "ForwardBatch")
+    elif isinstance(
+        inner, (pb.Prepare, pb.Commit, pb.FetchBatch, pb.RequestAck, pb.FetchRequest)
+    ):
+        _check_digest(inner.digest, max_digest, type(inner).__name__)
     elif isinstance(inner, pb.NewEpoch):
         cfg = inner.new_config
         if cfg is None:
@@ -45,18 +110,6 @@ def pre_process(msg: pb.Msg) -> None:
         if inner.epoch_change is None:
             raise MalformedMessage("EpochChangeAck without epoch_change")
     elif not isinstance(
-        inner,
-        (
-            pb.Preprepare,
-            pb.Prepare,
-            pb.Commit,
-            pb.Suspect,
-            pb.Checkpoint,
-            pb.RequestAck,
-            pb.FetchRequest,
-            pb.FetchBatch,
-            pb.ForwardBatch,
-            pb.EpochChange,
-        ),
+        inner, (pb.Suspect, pb.Checkpoint, pb.EpochChange)
     ):
         raise MalformedMessage(f"unknown message type {type(inner).__name__}")

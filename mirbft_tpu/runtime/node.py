@@ -17,7 +17,7 @@ import threading
 from .. import pb
 from ..core.state_machine import StateMachine
 from .config import Config
-from .msgfilter import pre_process
+from .msgfilter import MalformedMessage, pre_process
 
 
 class NodeStopped(Exception):
@@ -143,8 +143,19 @@ class Node:
 
     def step(self, source: int, msg: pb.Msg) -> None:
         """Inbound authenticated message from the transport.  Structural
-        validation runs in the caller's thread."""
-        pre_process(msg)
+        and size-bound validation runs in the caller's thread; rejections
+        are counted by taxonomy kind before the exception propagates (the
+        transport drops the frame)."""
+        try:
+            pre_process(msg, self.config)
+        except MalformedMessage as err:
+            from ..obsv import hooks
+
+            if hooks.enabled:
+                hooks.metrics.counter(
+                    "mirbft_byzantine_rejections_total", kind=err.kind
+                ).inc()
+            raise
         self._put(("step", source, msg))
 
     def propose(self, request: pb.Request) -> None:

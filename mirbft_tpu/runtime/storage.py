@@ -479,6 +479,14 @@ class FileRequestStore:
         for ack in acks:
             for_each(ack)
 
+    def pending_count(self) -> int:
+        """Stored-but-uncommitted entries.  Duplicate stores overwrite in
+        place, so under a duplication flood this is the memory-bound
+        evidence the chaos audit reads: at most one pending entry per
+        distinct request."""
+        with self._lock:
+            return len(self._index)
+
     def sync_token(self) -> int:
         """Group-commit ticket, mirroring FileWal.sync_token."""
         return self._group.token()

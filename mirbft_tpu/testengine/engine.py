@@ -257,6 +257,12 @@ class Recorder:
         self.reconfig_on_commit: dict = {}
 
         self.event_count = 0
+        # Proposal deliveries the signature plane refused at ingress —
+        # in-flight corruptions/forgeries.  The chaos corruption invariant
+        # asserts this equals the adversary's rewrite count (signed mode
+        # rejects 100%); mirrored to mirbft_byzantine_rejections_total
+        # when hooks are enabled.
+        self.byzantine_rejections = 0
         # Incremental mirror of per-node distinct-committed counts (the
         # drain predicates run every step; recounting the per-client sets
         # each time dominated large-run profiles).
@@ -585,6 +591,15 @@ class Recorder:
         for node in range(self.node_count):
             self._schedule(delay, node, event)
 
+    def _count_rejection(self, n: int) -> None:
+        """Account n signature-plane ingress rejections (corrupted or forged
+        proposal deliveries)."""
+        self.byzantine_rejections += n
+        if hooks.enabled:
+            hooks.metrics.counter(
+                "mirbft_byzantine_rejections_total", kind="corrupt"
+            ).inc(n)
+
     # -- the loop ------------------------------------------------------------
 
     def step(self) -> bool:
@@ -638,6 +653,7 @@ class Recorder:
                     # Ingress authentication failed: the replica never
                     # steps the state machine (unrecorded, like any
                     # dropped packet).
+                    self._count_rejection(1)
                     return True
             elif isinstance(inner, pb.EventProposeBatch):
                 valid = self.signature_plane.valid
@@ -646,6 +662,8 @@ class Recorder:
                     for r in inner.requests
                     if valid(r.client_id, r.req_no, r.data)
                 ]
+                if len(reqs) != len(inner.requests):
+                    self._count_rejection(len(inner.requests) - len(reqs))
                 if not reqs:
                     return True
                 if len(reqs) != len(inner.requests):

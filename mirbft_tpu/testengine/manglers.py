@@ -83,19 +83,22 @@ def to_node(*nodes: int):
 
 
 def from_client(*client_ids: int):
-    """Matches proposals and request acks of these clients."""
+    """Matches proposals, request acks, and forwarded requests of these
+    clients — every event through which a node can learn of a client's
+    request, which is exactly the surface a censoring leader suppresses."""
 
     def pred(_recorder, _when, _node, event):
-        inner = event.type
-        if isinstance(inner, pb.EventPropose) and inner.request is not None:
-            return inner.request.client_id in client_ids
-        if (
-            isinstance(inner, pb.EventStep)
-            and inner.msg is not None
-            and isinstance(inner.msg.type, pb.RequestAck)
-        ):
-            return inner.msg.type.client_id in client_ids
-        return False
+        pair = request_identity(event)
+        return pair is not None and pair[0] in client_ids
+
+    return pred
+
+
+def is_propose():
+    """Matches local client-ingress proposals (EventPropose)."""
+
+    def pred(_recorder, _when, _node, event):
+        return isinstance(event.type, pb.EventPropose)
 
     return pred
 
@@ -176,6 +179,60 @@ def once():
 
 
 # ---------------------------------------------------------------------------
+# Adversarial helpers
+# ---------------------------------------------------------------------------
+
+
+def request_identity(event) -> tuple[int, int] | None:
+    """The (client_id, req_no) a request-carrying event speaks for, or None.
+
+    Covers the three delivery paths a request can take to a node: local
+    proposal (EventPropose), ack gossip (RequestAck), and data forwarding
+    (ForwardRequest)."""
+    inner = event.type
+    if isinstance(inner, pb.EventPropose) and inner.request is not None:
+        req = inner.request
+        return (req.client_id, req.req_no)
+    if isinstance(inner, pb.EventStep) and inner.msg is not None:
+        msg = inner.msg.type
+        if isinstance(msg, pb.RequestAck):
+            return (msg.client_id, msg.req_no)
+        if isinstance(msg, pb.ForwardRequest) and msg.request_ack is not None:
+            ack = msg.request_ack
+            return (ack.client_id, ack.req_no)
+    return None
+
+
+def _flip_bytes(data: bytes, rng, flips: int) -> bytes:
+    """Returns data with up to ``flips`` bytes XOR-ed against nonzero masks
+    (seeded rng) — guaranteed != data whenever data is non-empty."""
+    if not data:
+        return data
+    mutated = bytearray(data)
+    for _ in range(max(flips, 1)):
+        pos = rng.randrange(len(mutated))
+        mutated[pos] ^= rng.randint(1, 255)
+    return bytes(mutated)
+
+
+def _variant_digest(digest: bytes) -> bytes:
+    """Deterministic conflicting digest for an equivocated batch: same for
+    every victim of the same (epoch, seq), so the equivocating leader tells
+    one consistent lie per subset — the hardest case for fork detection."""
+    if not digest:
+        return b"\xff"
+    return digest[:-1] + bytes([digest[-1] ^ 0xFF])
+
+
+def _restep(inner: "pb.EventStep", msg) -> "pb.StateEvent":
+    """A fresh EventStep event carrying ``msg`` from the same source; never
+    mutates the original (other targets share the event object)."""
+    return pb.StateEvent(
+        type=pb.EventStep(source=inner.source, msg=pb.Msg(type=msg))
+    )
+
+
+# ---------------------------------------------------------------------------
 # Rules and actions
 # ---------------------------------------------------------------------------
 
@@ -236,6 +293,170 @@ class _Rule:
             return (when, node, event)
 
         mangler.duplicated = 0
+        return mangler
+
+    def corrupt(self, byte_flips: int = 1):
+        """Flips payload/digest bytes of matched events in flight (seeded
+        rng), modelling a compromised link or leader that tampers with
+        content rather than delivery.  Rewrites — never mutates — the event:
+
+        * EventPropose: the request data (signed mode must reject it);
+        * RequestAck / Prepare / Commit: the digest;
+        * ForwardRequest: the forwarded request data (the receiver's digest
+          re-verification must drop it);
+        * Preprepare: one batch entry's digest.
+
+        Counts rewrites on ``corrupted``, and the EventPropose subset —
+        the deliveries a signature plane is obligated to reject — on
+        ``corrupted_proposes``."""
+
+        def mangler(recorder, when, node, event):
+            if not self._matches(recorder, when, node, event):
+                return (when, node, event)
+            rng = recorder.rng
+            inner = event.type
+            if isinstance(inner, pb.EventPropose) and inner.request is not None:
+                req = inner.request
+                twisted = _flip_bytes(req.data, rng, byte_flips)
+                if twisted == req.data:
+                    return (when, node, event)
+                mangler.corrupted += 1
+                mangler.corrupted_proposes += 1
+                forged = pb.Request(
+                    client_id=req.client_id, req_no=req.req_no, data=twisted
+                )
+                return (when, node, pb.StateEvent(type=pb.EventPropose(request=forged)))
+            if isinstance(inner, pb.EventStep) and inner.msg is not None:
+                msg = inner.msg.type
+                if isinstance(msg, pb.RequestAck):
+                    mangler.corrupted += 1
+                    forged = pb.RequestAck(
+                        client_id=msg.client_id,
+                        req_no=msg.req_no,
+                        digest=_flip_bytes(msg.digest, rng, byte_flips),
+                    )
+                    return (when, node, _restep(inner, forged))
+                if isinstance(msg, (pb.Prepare, pb.Commit)):
+                    mangler.corrupted += 1
+                    forged = type(msg)(
+                        seq_no=msg.seq_no,
+                        epoch=msg.epoch,
+                        digest=_flip_bytes(msg.digest, rng, byte_flips),
+                    )
+                    return (when, node, _restep(inner, forged))
+                if isinstance(msg, pb.ForwardRequest) and msg.request_ack is not None:
+                    mangler.corrupted += 1
+                    forged = pb.ForwardRequest(
+                        request_ack=msg.request_ack,
+                        request_data=_flip_bytes(msg.request_data, rng, byte_flips),
+                    )
+                    return (when, node, _restep(inner, forged))
+                if isinstance(msg, pb.Preprepare) and msg.batch:
+                    mangler.corrupted += 1
+                    victim = rng.randrange(len(msg.batch))
+                    batch = list(msg.batch)
+                    ack = batch[victim]
+                    batch[victim] = pb.RequestAck(
+                        client_id=ack.client_id,
+                        req_no=ack.req_no,
+                        digest=_flip_bytes(ack.digest, rng, byte_flips),
+                    )
+                    forged = pb.Preprepare(
+                        seq_no=msg.seq_no, epoch=msg.epoch, batch=batch
+                    )
+                    return (when, node, _restep(inner, forged))
+            return (when, node, event)
+
+        mangler.corrupted = 0
+        mangler.corrupted_proposes = 0
+        return mangler
+
+    def equivocate(self, victims):
+        """The matched Preprepare's sender lies to ``victims``: they receive
+        a conflicting batch (every digest swapped for a deterministic
+        variant) for the same (epoch, seq), while other nodes see the real
+        one — the paper's equivocating-leader attack.  The variant digests
+        reference no existing request, so a victim can never assemble the
+        batch: either the honest subset still reaches quorum (victims catch
+        up via state transfer) or the sequence stalls and the suspect
+        machinery rotates the liar out.  Counts rewrites on ``equivocated``
+        and records {(epoch, seq): (real digests, variant digests)} on
+        ``variants`` for the no-fork audit."""
+        victim_set = frozenset(victims)
+
+        def mangler(recorder, when, node, event):
+            if node in victim_set and self._matches(recorder, when, node, event):
+                inner = event.type
+                if (
+                    isinstance(inner, pb.EventStep)
+                    and inner.msg is not None
+                    and isinstance(inner.msg.type, pb.Preprepare)
+                    and inner.msg.type.batch
+                ):
+                    msg = inner.msg.type
+                    batch = [
+                        pb.RequestAck(
+                            client_id=a.client_id,
+                            req_no=a.req_no,
+                            digest=_variant_digest(a.digest),
+                        )
+                        for a in msg.batch
+                    ]
+                    mangler.equivocated += 1
+                    mangler.variants[(msg.epoch, msg.seq_no)] = (
+                        tuple(a.digest for a in msg.batch),
+                        tuple(a.digest for a in batch),
+                    )
+                    forged = pb.Preprepare(
+                        seq_no=msg.seq_no, epoch=msg.epoch, batch=batch
+                    )
+                    return (when, node, _restep(inner, forged))
+            return (when, node, event)
+
+        mangler.equivocated = 0
+        mangler.variants = {}
+        return mangler
+
+    def censor(self):
+        """Silently drops matched request-carrying events — a censoring
+        leader suppressing targeted clients at ingress.  Unlike ``drop()``
+        it only swallows events that speak for a request (proposals, acks,
+        forwards) and records which (client_id, req_no) pairs were censored
+        on ``censored_pairs``, so the liveness audit can assert each one
+        still commits once bucket rotation hands the bucket to an honest
+        leader.  Combine with ``to_node(leader)`` + ``from_client(...)``."""
+
+        def mangler(recorder, when, node, event):
+            if self._matches(recorder, when, node, event):
+                pair = request_identity(event)
+                if pair is not None:
+                    mangler.censored += 1
+                    mangler.censored_pairs.add(pair)
+                    return None
+            return (when, node, event)
+
+        mangler.censored = 0
+        mangler.censored_pairs = set()
+        return mangler
+
+    def flood(self, copies: int, max_delay_ms: int):
+        """Duplication / stale-ack storm: every matched event is delivered,
+        plus ``copies`` echoes spread over (0, max_delay_ms] (seeded rng).
+        With a large delay the echoes arrive long after the original
+        committed — the paper's stale-ack attack on the dedup path.  Counts
+        echoes on ``flooded``."""
+
+        def mangler(recorder, when, node, event):
+            if self._matches(recorder, when, node, event):
+                out = [(when, node, event)]
+                for _ in range(copies):
+                    echo = when + recorder.rng.randint(1, max(max_delay_ms, 1))
+                    out.append((echo, node, event))
+                mangler.flooded += copies
+                return out
+            return (when, node, event)
+
+        mangler.flooded = 0
         return mangler
 
     def crash_and_restart_after(self, delay_ms: int, node: int | None = None):

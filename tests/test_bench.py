@@ -120,3 +120,9 @@ def test_live_payload_keys_present_in_main_schema():
     assert '"live_reqs_per_sec_serial"' in src
     assert '"live_reqs_per_sec_pipelined"' in src
     assert '"live_pipelined_speedup"' in src
+    # Attack rung: the duplication-flood A/B keys obsv --diff gates.
+    assert '"live_attack_goodput_per_sec"' in src
+    assert '"live_attack_commit_p95_ms"' in src
+    assert '"live_attack_clean_goodput_per_sec"' in src
+    assert '"live_attack_clean_commit_p95_ms"' in src
+    assert '"live_attack_goodput_ratio"' in src

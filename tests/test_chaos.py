@@ -5,14 +5,23 @@ The three-smoke subset (partition + heal, crash + restart, device-plane
 failure) runs in tier-1; the full matrix rides the slow lane alongside
 ``python -m mirbft_tpu.chaos``."""
 
+import dataclasses
+
 import pytest
 
 from mirbft_tpu import pb
 from mirbft_tpu.chaos import (
+    ADVERSARY_SMOKE_NAMES,
     CrashSnapshot,
     InvariantViolation,
+    adversary_matrix,
+    adversary_smoke_matrix,
+    check_censorship_liveness,
+    check_corruption_rejected,
     check_durable_prefix,
+    check_flood_bounded,
     check_no_fork,
+    check_no_fork_under_equivocation,
     matrix,
     run_campaign,
     run_scenario,
@@ -23,6 +32,7 @@ from mirbft_tpu.testengine import BasicRecorder
 from mirbft_tpu.testengine.manglers import partition
 
 BY_NAME = {s.name: s for s in matrix()}
+ADV_BY_NAME = {s.name: s for s in adversary_matrix()}
 
 
 # ---------------------------------------------------------------------------
@@ -111,6 +121,72 @@ def test_durable_prefix_detects_lost_and_rewritten_commits():
     )
     with pytest.raises(InvariantViolation, match="rewrote durable history"):
         check_durable_prefix(r, [rewritten])
+
+
+# ---------------------------------------------------------------------------
+# Byzantine invariants detect doctored evidence (and vacuous scenarios)
+# ---------------------------------------------------------------------------
+
+
+def test_corruption_rejected_requires_exactly_100_percent():
+    check_corruption_rejected(rejections=5, corrupted=5)
+    with pytest.raises(InvariantViolation, match="rejected 4 of 5"):
+        check_corruption_rejected(4, 5)
+    with pytest.raises(InvariantViolation, match="rejected 6 of 5"):
+        check_corruption_rejected(6, 5)
+    with pytest.raises(InvariantViolation, match="vacuous"):
+        check_corruption_rejected(0, 0)
+
+
+def test_no_fork_under_equivocation_detects_divergence_and_vacuity():
+    r = _tiny_converged_recorder()
+    variants = {(1, 1): ((b"real",), (b"variant",))}
+    check_no_fork_under_equivocation(r, variants)
+
+    with pytest.raises(InvariantViolation, match="vacuous"):
+        check_no_fork_under_equivocation(r, {})
+    # A quiet run never left the boot epoch, so demanding suspicion
+    # evidence must fail — the regression net for the epoch-1 baseline.
+    with pytest.raises(InvariantViolation, match="never suspected"):
+        check_no_fork_under_equivocation(r, variants, expect_suspicion=True)
+
+    r.node_states[1].app_chain = "doctored-divergent-chain"
+    with pytest.raises(InvariantViolation, match="diverge"):
+        check_no_fork_under_equivocation(r, variants)
+
+
+def test_censorship_liveness_detects_starvation_lateness_and_vacuity():
+    r = _tiny_converged_recorder()
+    cid = next(iter(r.clients))
+    censored = {(cid, 0)}
+    check_censorship_liveness(r, censored, {(cid, 0): 1}, k=3)
+
+    with pytest.raises(InvariantViolation, match="vacuous"):
+        check_censorship_liveness(r, set(), {}, k=3)
+    with pytest.raises(InvariantViolation, match="never committed"):
+        check_censorship_liveness(r, {(cid, 999)}, {}, k=3)
+    with pytest.raises(InvariantViolation, match="more than 3 epoch"):
+        check_censorship_liveness(r, censored, {(cid, 0): 5}, k=3)
+    # Every censored request committing without any rotation means the
+    # censor never owned a victim bucket — vacuous, not a pass.
+    with pytest.raises(InvariantViolation, match="vacuous"):
+        check_censorship_liveness(r, censored, {(cid, 0): 0}, k=3)
+
+
+def test_flood_bounded_detects_duplicates_and_unbounded_growth():
+    r = _tiny_converged_recorder()
+    check_flood_bounded(r, flooded=10)
+
+    with pytest.raises(InvariantViolation, match="vacuous"):
+        check_flood_bounded(r, flooded=0)
+    with pytest.raises(InvariantViolation, match="checkpoint truncation"):
+        check_flood_bounded(r, flooded=10, wal_bound=0)
+
+    r.node_states[2].committed_reqs.append(
+        r.node_states[2].committed_reqs[-1]
+    )
+    with pytest.raises(InvariantViolation, match="exactly-once"):
+        check_flood_bounded(r, flooded=10)
 
 
 # ---------------------------------------------------------------------------
@@ -213,7 +289,66 @@ def test_signed_mode_verifier_death_walks_breaker_to_recovery():
 
 
 # ---------------------------------------------------------------------------
-# The full matrix (slow lane; also: python -m mirbft_tpu.chaos)
+# The tier-1 adversary smoke: equivocation + duplication flood
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_adversary_smoke_equivocation_forces_suspicion():
+    """Leader 0 tells conflicting Preprepares to a follower majority: no
+    digest reaches quorum, the honest nodes suspect the liar and change
+    epochs, and every sequence commits exactly once (the runner's
+    equivocation audit demands both the no-fork proof and the epoch
+    rotation)."""
+    result = run_scenario(ADV_BY_NAME["equivocate-majority-suspect"], seed=0)
+    assert result.passed, result.violation
+    assert result.counters["equivocated"] > 0
+    assert result.counters["epoch"] >= 2  # beyond the boot epoch
+
+
+@pytest.mark.chaos
+def test_adversary_smoke_flood_commits_exactly_once():
+    """The paper's request-duplication attack: 75% of submissions
+    delivered 4x; dedup must commit exactly once with bounded request
+    store and WAL (audited by check_flood_bounded inside the runner)."""
+    result = run_scenario(ADV_BY_NAME["flood-duplicate-proposes"], seed=1)
+    assert result.passed, result.violation
+    assert result.counters["flooded"] > 0
+
+
+@pytest.mark.chaos
+def test_adversary_smoke_names_cover_two_attack_families():
+    assert {s.name for s in adversary_smoke_matrix()} == set(
+        ADVERSARY_SMOKE_NAMES
+    )
+    assert len(ADVERSARY_SMOKE_NAMES) == 2
+
+
+# ---------------------------------------------------------------------------
+# Epoch-baseline regression: the vacuity hole the adversary work exposed
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_expect_epoch_change_rejects_boot_epoch():
+    """Every run negotiates epoch 1 at boot (the seed WAL's FEntry ends
+    epoch 0), so 'reached epoch 1' is not evidence of a forced change.
+    Before the adversary campaign, an expect_epoch_change scenario whose
+    cluster sat quietly in the boot epoch passed vacuously; now it must
+    fail."""
+    quiet = dataclasses.replace(
+        BY_NAME["partition-minority"],
+        name="quiet-expect-epoch-change",
+        partitions=(),
+        expect_epoch_change=True,
+    )
+    result = run_scenario(quiet, seed=0)
+    assert not result.passed
+    assert "boot epoch" in result.violation
+
+
+# ---------------------------------------------------------------------------
+# The full matrices (slow lane; also: python -m mirbft_tpu.chaos)
 # ---------------------------------------------------------------------------
 
 
@@ -222,4 +357,14 @@ def test_signed_mode_verifier_death_walks_breaker_to_recovery():
 def test_full_campaign_passes_all_invariants():
     campaign = run_campaign(seed=0)
     assert len(campaign.results) >= 12
+    assert campaign.passed, campaign.report()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_full_adversary_campaign_passes_all_invariants():
+    """All four attack families — corrupt, equivocate, censor, flood —
+    across the seeded deterministic matrix."""
+    campaign = run_campaign(adversary_matrix(), seed=0)
+    assert len(campaign.results) >= 10
     assert campaign.passed, campaign.report()

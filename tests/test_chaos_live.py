@@ -17,6 +17,7 @@ Three layers:
   ``slow``, mirroring tests/test_chaos.py's deterministic campaign.
 """
 
+import dataclasses
 import threading
 import time
 
@@ -25,6 +26,7 @@ import pytest
 from mirbft_tpu import pb
 from mirbft_tpu.chaos import (
     LIVE_SMOKE_NAMES,
+    live_adversary_matrix,
     live_matrix,
     run_live_campaign,
     run_live_scenario,
@@ -33,6 +35,7 @@ from mirbft_tpu.runtime import Config, Node, TcpTransport
 from mirbft_tpu.runtime.node import standard_initial_network_state
 
 BY_NAME = {s.name: s for s in live_matrix()}
+ADV_BY_NAME = {s.name: s for s in live_adversary_matrix()}
 
 # Every thread the runtime plane spawns carries one of these name
 # prefixes (node.py / transport.py / live.py / processor.py /
@@ -184,10 +187,69 @@ def test_live_signed_mode_verifier_death_recovers():
     assert result.commits > 0
 
 
+# ---------------------------------------------------------------------------
+# Byzantine adversaries over real sockets (frame-rewriting proxies)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_live_adversary_corruption_rejected_on_real_sockets():
+    """Corrupted proposal deliveries over real TCP: signed ingress must
+    reject every rewrite (rejections == corruptions, the 100% bar the
+    corruption invariant enforces) while honest copies still commit."""
+    result = run_live_scenario(
+        ADV_BY_NAME["corrupt-propose-signed"], seed=0, budget_s=60.0
+    )
+    assert result.passed, result.violation
+    assert result.counters["corrupted"] > 0
+    assert result.counters["rejections"] == result.counters["corrupted"]
+    assert result.commits > 0
+
+
+@pytest.mark.chaos
+def test_live_adversary_flood_absorbed_on_real_sockets():
+    """Duplication flood through the wire proxies and the client seam:
+    dedup must commit exactly once with a bounded request store (audited
+    inside the live driver's flood check)."""
+    result = run_live_scenario(
+        ADV_BY_NAME["flood-duplicate-proposes"], seed=0, budget_s=60.0
+    )
+    assert result.passed, result.violation
+    assert result.counters["flooded"] > 0
+    assert result.commits > 0
+
+
+@pytest.mark.chaos
+def test_live_expect_epoch_change_rejects_boot_epoch():
+    """Live regression for the epoch-baseline hole: live clusters also
+    boot into epoch 1 and fire epoch.active milestones for it, so a quiet
+    run must FAIL an expect_epoch_change scenario rather than pass on
+    boot telemetry."""
+    quiet = dataclasses.replace(
+        BY_NAME["partition-minority"],
+        name="quiet-expect-epoch-change",
+        partitions=(),
+        expect_epoch_change=True,
+    )
+    result = run_live_scenario(quiet, seed=0, budget_s=60.0)
+    assert not result.passed
+    assert "boot epoch" in result.violation
+
+
 @pytest.mark.chaos
 @pytest.mark.slow
 def test_live_full_campaign():
     """The whole live matrix — crash, partition, loss, leader isolation,
     signed mode, failing fsyncs — against real clusters."""
     campaign = run_live_campaign(seed=0)
+    assert campaign.passed, campaign.report()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_live_adversary_campaign():
+    """All four attack families against real TCP clusters: corrupting,
+    equivocating, censoring, and flooding leaders behind frame-rewriting
+    socket proxies (``python -m mirbft_tpu.chaos --live --adversary``)."""
+    campaign = run_live_campaign(live_adversary_matrix(), seed=0)
     assert campaign.passed, campaign.report()

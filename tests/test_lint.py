@@ -334,6 +334,58 @@ def test_linter_confines_process_management_to_cluster(tmp_path):
     assert not any("W11" in line for line in lint.check_file(tests_ok))
 
 
+def test_linter_confines_adversary_tooling_to_harness(tmp_path):
+    """W13: core/ and runtime/ must not import mirbft_tpu.testengine or
+    mirbft_tpu.chaos in any spelling — payload mutation and frame
+    rewriting belong to the harness, which wraps the protocol, never the
+    reverse."""
+    import lint
+
+    spellings = (
+        "from mirbft_tpu.testengine.manglers import rule\nx = rule\n",
+        "import mirbft_tpu.chaos.live\nx = mirbft_tpu\n",
+        "from mirbft_tpu import chaos\nx = chaos\n",
+        "from ..testengine import manglers\nx = manglers\n",
+        "from ..chaos.live import AdversaryProxy\nx = AdversaryProxy\n",
+        "from .. import testengine\nx = testengine\n",
+    )
+    for tree in ("core", "runtime"):
+        for index, source in enumerate(spellings):
+            bad = tmp_path / "mirbft_tpu" / tree / f"sneaky{index}.py"
+            bad.parent.mkdir(parents=True, exist_ok=True)
+            bad.write_text(source)
+            findings = lint.check_file(bad)
+            assert any("W13" in line for line in findings), (
+                tree,
+                source,
+                findings,
+            )
+
+    # The harness trees import each other freely.
+    inside = tmp_path / "mirbft_tpu" / "chaos" / "fine.py"
+    inside.parent.mkdir(parents=True)
+    inside.write_text("from ..testengine.manglers import rule\nx = rule\n")
+    assert not any("W13" in line for line in lint.check_file(inside))
+
+    # Protocol-internal relative imports stay clean in scope.
+    honest = tmp_path / "mirbft_tpu" / "runtime" / "honest.py"
+    honest.write_text("from ..core import serializer\nx = serializer\n")
+    assert not any("W13" in line for line in lint.check_file(honest))
+
+    # The real protocol trees are clean today; keep them that way.
+    for tree in ("core", "runtime"):
+        for path in sorted((REPO / "mirbft_tpu" / tree).glob("*.py")):
+            assert not any(
+                "W13" in line for line in lint.check_file(path)
+            ), path
+
+    # Tests and tools are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text("from mirbft_tpu.chaos import run_campaign\nx = run_campaign\n")
+    assert not any("W13" in line for line in lint.check_file(tests_ok))
+
+
 # ---------------------------------------------------------------------------
 # rule engine (tools/analysis/engine.py)
 # ---------------------------------------------------------------------------

@@ -195,6 +195,131 @@ def test_restart_boot_sequence_immune_to_manglers():
     assert all_agree(r)
 
 
+# ---------------------------------------------------------------------------
+# Adversary verbs: predicate composition + campaign determinism
+# ---------------------------------------------------------------------------
+
+
+def exactly_once(r):
+    for n in range(r.node_count):
+        committed = [(c, q) for (c, q, _s) in r.node_states[n].committed_reqs]
+        assert len(committed) == len(set(committed)), "duplicate commit!"
+
+
+def test_corrupt_composes_with_percent():
+    """corrupt() rewrites only the sampled subset: 15% of Prepare/Commit
+    digests are bit-flipped in flight, and quorum redundancy absorbs every
+    one without a fork or duplicate commit."""
+    mangler = rule(msg_type("Prepare", "Commit"), percent(15)).corrupt()
+    r = BasicRecorder(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=10,
+        manglers=[mangler],
+    )
+    r.drain_clients(max_steps=600000)
+    assert all_agree(r)
+    assert mangler.corrupted > 0
+    exactly_once(r)
+
+
+def test_equivocate_composes_with_seq_no():
+    """equivocate() scoped by with_seq_no forges only the windowed
+    Preprepares toward the victim; the honest majority commits the real
+    batches and the victim catches up without ever committing a variant."""
+    mangler = rule(msg_type("Preprepare"), with_seq_no(1, 3)).equivocate((3,))
+    r = BasicRecorder(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=10,
+        manglers=[mangler],
+    )
+    r.drain_clients(max_steps=600000)
+    assert all_agree(r)
+    assert mangler.equivocated > 0
+    assert all(1 <= seq <= 3 for (_epoch, seq) in mangler.variants)
+    exactly_once(r)
+
+
+def test_censor_composes_with_from_client():
+    """censor() scoped by to_node + from_client suppresses only the victim
+    client's request traffic into the censoring node — and every censored
+    (client, req_no) pair still commits once the window expires (the fetch
+    machinery retries past it; a censoring *leader* needs bucket rotation,
+    which the chaos censor scenarios exercise).  The temporal predicate
+    composes left to right: until_events counts only events the
+    to_node/from_client predicates already matched."""
+    mangler = rule(to_node(1), from_client(4), until_events(8)).censor()
+    r = BasicRecorder(
+        node_count=4,
+        client_count=2,
+        reqs_per_client=6,
+        manglers=[mangler],
+    )
+    r.drain_clients(max_steps=600000)
+    assert all_agree(r)
+    assert mangler.censored > 0
+    assert mangler.censored_pairs
+    assert all(cid == 4 for (cid, _q) in mangler.censored_pairs)
+    for n in range(4):
+        committed = {(c, q) for (c, q, _s) in r.node_states[n].committed_reqs}
+        assert mangler.censored_pairs <= committed
+    exactly_once(r)
+
+
+def _scenario_recorder(scenario, seed):
+    """Mirror chaos.runner.run_scenario's recorder construction, but with
+    record=True so two runs' logs can be compared event for event."""
+    signer = signature_plane = None
+    if scenario.signed:
+        from mirbft_tpu.testengine.signing import SignaturePlane, make_signer
+
+        signer = make_signer()
+        signature_plane = (
+            scenario.signature_plane()
+            if scenario.signature_plane
+            else SignaturePlane()
+        )
+    return BasicRecorder(
+        node_count=scenario.node_count,
+        client_count=scenario.client_count,
+        reqs_per_client=scenario.reqs_per_client,
+        batch_size=scenario.batch_size,
+        seed=seed,
+        manglers=scenario.build_manglers(),
+        hash_plane=scenario.hash_plane() if scenario.hash_plane else None,
+        signer=signer,
+        signature_plane=signature_plane,
+        network_state=(
+            scenario.network_state() if scenario.network_state else None
+        ),
+        record=True,
+    )
+
+
+def _adversary_names():
+    from mirbft_tpu.chaos.scenarios import adversary_matrix
+
+    return [s.name for s in adversary_matrix()]
+
+
+@pytest.mark.parametrize("name", _adversary_names())
+def test_adversary_runs_are_deterministic(name):
+    """Same seed -> byte-identical recorder log under every adversary: the
+    corrupt/equivocate/censor/flood verbs draw only from the recorder's
+    seeded rng, so any failing campaign seed replays exactly."""
+    from mirbft_tpu.chaos.scenarios import adversary_matrix
+
+    scenario = {s.name: s for s in adversary_matrix()}[name]
+
+    def run(seed):
+        rec = _scenario_recorder(scenario, seed)
+        rec.drain_clients(max_steps=150000)
+        return repr((rec.now, rec.event_count, rec.recorded_events))
+
+    assert run(7) == run(7)
+
+
 def test_targeted_seqno_drop_recovers():
     """Dropping the first Preprepares for a seqno window only delays those
     sequences (retransmit/epoch machinery recovers)."""

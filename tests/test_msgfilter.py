@@ -1,0 +1,163 @@
+"""Ingress frame bounds: msgfilter.pre_process rejects oversized batches,
+payloads, and digests against Config limits, with a taxonomy ``kind`` on
+every MalformedMessage so rejections are countable by cause."""
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.obsv import hooks
+from mirbft_tpu.runtime import Config
+from mirbft_tpu.runtime.msgfilter import MalformedMessage, pre_process
+
+
+def _ack(digest=b"d" * 32, client=4, req_no=0):
+    return pb.RequestAck(client_id=client, req_no=req_no, digest=digest)
+
+
+def _msg(inner):
+    return pb.Msg(type=inner)
+
+
+def _kind(call):
+    with pytest.raises(MalformedMessage) as excinfo:
+        call()
+    return excinfo.value.kind
+
+
+def test_honest_messages_pass_default_limits():
+    pre_process(_msg(pb.Preprepare(seq_no=1, epoch=1, batch=[_ack()])))
+    pre_process(_msg(pb.Prepare(seq_no=1, epoch=1, digest=b"d" * 32)))
+    pre_process(_msg(pb.Commit(seq_no=1, epoch=1, digest=b"d" * 32)))
+    pre_process(_msg(_ack()))
+    pre_process(
+        _msg(pb.ForwardRequest(request_ack=_ack(), request_data=b"x" * 64))
+    )
+    pre_process(
+        _msg(
+            pb.ForwardBatch(
+                seq_no=1, request_acks=[_ack()], digest=b"d" * 32
+            )
+        )
+    )
+
+
+def test_structural_rejections_keep_malformed_kind():
+    assert _kind(lambda: pre_process(pb.Msg(type=None))) == "malformed"
+    assert (
+        _kind(lambda: pre_process(_msg(pb.ForwardRequest(request_ack=None))))
+        == "malformed"
+    )
+    assert (
+        _kind(lambda: pre_process(_msg(pb.NewEpoch(new_config=None))))
+        == "malformed"
+    )
+
+
+def test_oversized_preprepare_batch_rejected():
+    batch = [_ack(req_no=i) for i in range(300)]
+    kind = _kind(
+        lambda: pre_process(_msg(pb.Preprepare(seq_no=1, epoch=1, batch=batch)))
+    )
+    assert kind == "oversized_batch"
+
+
+def test_oversized_forward_batch_rejected():
+    acks = [_ack(req_no=i) for i in range(300)]
+    kind = _kind(
+        lambda: pre_process(
+            _msg(pb.ForwardBatch(seq_no=1, request_acks=acks, digest=b""))
+        )
+    )
+    assert kind == "oversized_batch"
+
+
+def test_oversized_payload_rejected():
+    inner = pb.ForwardRequest(
+        request_ack=_ack(), request_data=b"x" * (1024 * 1024 + 1)
+    )
+    assert _kind(lambda: pre_process(_msg(inner))) == "oversized_payload"
+
+
+@pytest.mark.parametrize(
+    "inner",
+    [
+        pb.Prepare(seq_no=1, epoch=1, digest=b"d" * 65),
+        pb.Commit(seq_no=1, epoch=1, digest=b"d" * 65),
+        pb.RequestAck(client_id=4, req_no=0, digest=b"d" * 65),
+        pb.FetchBatch(seq_no=1, digest=b"d" * 65),
+        pb.FetchRequest(client_id=4, req_no=0, digest=b"d" * 65),
+        pb.ForwardBatch(seq_no=1, request_acks=[], digest=b"d" * 65),
+        pb.Preprepare(seq_no=1, epoch=1, batch=[_ack(digest=b"d" * 65)]),
+        pb.ForwardRequest(request_ack=_ack(digest=b"d" * 65)),
+    ],
+)
+def test_oversized_digest_rejected_everywhere(inner):
+    assert _kind(lambda: pre_process(_msg(inner))) == "oversized_digest"
+
+
+def test_config_limits_override_defaults():
+    config = Config(
+        id=0, max_batch_acks=2, max_request_bytes=16, max_digest_bytes=32
+    )
+    pre_process(
+        _msg(pb.Preprepare(seq_no=1, epoch=1, batch=[_ack(), _ack(req_no=1)])),
+        config,
+    )
+    kind = _kind(
+        lambda: pre_process(
+            _msg(
+                pb.Preprepare(
+                    seq_no=1,
+                    epoch=1,
+                    batch=[_ack(req_no=i) for i in range(3)],
+                )
+            ),
+            config,
+        )
+    )
+    assert kind == "oversized_batch"
+    kind = _kind(
+        lambda: pre_process(
+            _msg(pb.ForwardRequest(request_ack=_ack(), request_data=b"x" * 17)),
+            config,
+        )
+    )
+    assert kind == "oversized_payload"
+    kind = _kind(
+        lambda: pre_process(_msg(pb.Prepare(digest=b"d" * 33)), config)
+    )
+    assert kind == "oversized_digest"
+
+
+def test_node_step_counts_rejections_by_kind():
+    """Node.step enforces its Config bounds and labels the rejection
+    metric with the taxonomy kind before the transport drops the frame."""
+    from mirbft_tpu.runtime import Node
+    from mirbft_tpu.runtime.node import standard_initial_network_state
+
+    metrics, _ = hooks.enable()
+    node = None
+    try:
+        node = Node.start_new(
+            config=Config(id=0, max_batch_acks=4),
+            initial_network_state=standard_initial_network_state(4, [4]),
+        )
+        with pytest.raises(MalformedMessage):
+            node.step(
+                1,
+                _msg(
+                    pb.Preprepare(
+                        seq_no=1,
+                        epoch=1,
+                        batch=[_ack(req_no=i) for i in range(5)],
+                    )
+                ),
+            )
+        counter = metrics.counter(
+            "mirbft_byzantine_rejections_total", kind="oversized_batch"
+        )
+        assert counter.value == 1
+    finally:
+        if node is not None:
+            node.stop()
+        hooks.disable()

@@ -1,5 +1,6 @@
-"""General defect classes W1..W12 (the original tools/lint.py checks as
-Rule objects, message-compatible, plus the seeded-randomness ban).
+"""General defect classes W1..W13 (the original tools/lint.py checks as
+Rule objects, message-compatible, plus the seeded-randomness ban and the
+adversary-tooling confinement).
 
 The catalog (rationale per rule lives in docs/ANALYSIS.md):
 
@@ -20,6 +21,11 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   Seeded reproducibility is the chaos/testengine contract: every fault
   schedule, mangler decision, arrival process, and jitter sequence must
   replay from its seed.
+- W13 adversary tooling (``mirbft_tpu.testengine`` / ``mirbft_tpu.chaos``
+  — payload mutation, frame rewriting, fault injection) imported from
+  ``core/`` or ``runtime/``.  The protocol must not depend on its own
+  attack harness; the flow is strictly one-way (the harness wraps the
+  protocol, never the reverse).
 """
 
 from __future__ import annotations
@@ -159,6 +165,20 @@ def in_package_scope(posix: str) -> bool:
     """True for files inside mirbft_tpu/ (W12's scope: tests, tools, and
     bench may use ambient randomness freely)."""
     return "mirbft_tpu/" in posix
+
+
+# Subpackages holding the adversary machinery: payload-mutation manglers
+# (testengine/manglers.py) and frame-rewriting / fault-injection drivers
+# (chaos/).  The protocol trees below must never import them — the attack
+# harness wraps the protocol, never the reverse.
+ADVERSARY_PACKAGES = ("testengine", "chaos")
+
+PROTOCOL_TREES = ("mirbft_tpu/core/", "mirbft_tpu/runtime/")
+
+
+def in_adversary_ban_scope(posix: str) -> bool:
+    """True for files inside the protocol trees W13 protects."""
+    return any(tree in posix for tree in PROTOCOL_TREES)
 
 
 def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
@@ -481,6 +501,60 @@ def check_w12(ctx: FileContext):
                     )
 
 
+def _adversary_package(node: ast.AST) -> str | None:
+    """The banned subpackage an import statement reaches, or None.
+
+    Catches every spelling: ``import mirbft_tpu.chaos.live``,
+    ``from mirbft_tpu.testengine.manglers import rule``,
+    ``from mirbft_tpu import chaos``, and the relative forms
+    ``from ..chaos import x`` / ``from .. import testengine`` that
+    core/runtime modules would actually write."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            for pkg in ADVERSARY_PACKAGES:
+                full = f"mirbft_tpu.{pkg}"
+                if alias.name == full or alias.name.startswith(full + "."):
+                    return pkg
+        return None
+    if not isinstance(node, ast.ImportFrom):
+        return None
+    module = node.module or ""
+    if node.level == 0:
+        for pkg in ADVERSARY_PACKAGES:
+            full = f"mirbft_tpu.{pkg}"
+            if module == full or module.startswith(full + "."):
+                return pkg
+        if module == "mirbft_tpu":
+            for alias in node.names:
+                if alias.name in ADVERSARY_PACKAGES:
+                    return alias.name
+        return None
+    # Relative import from inside the package.
+    head = module.split(".", 1)[0]
+    if head in ADVERSARY_PACKAGES:
+        return head
+    if not module:
+        for alias in node.names:
+            if alias.name in ADVERSARY_PACKAGES:
+                return alias.name
+    return None
+
+
+def _check_w13(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        pkg = _adversary_package(node)
+        if pkg is not None:
+            yield Finding(
+                "W13",
+                ctx.path,
+                node.lineno,
+                f"adversary tooling mirbft_tpu.{pkg} imported from "
+                "core/runtime (payload mutation and frame rewriting live "
+                "in testengine/ and chaos/; the harness wraps the "
+                "protocol, never the reverse)",
+            )
+
+
 def _as_list(gen_fn):
     def check(ctx):
         return list(gen_fn(ctx))
@@ -601,6 +675,19 @@ register(
         ),
         check=_as_list(_check_w11),
         scope=in_process_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W13",
+        title="adversary tooling imported from core/runtime",
+        doc=(
+            "Payload-mutation and frame-rewriting helpers are confined to "
+            "testengine/ and chaos/; the protocol trees must not import "
+            "their own attack harness."
+        ),
+        check=_as_list(_check_w13),
+        scope=in_adversary_ban_scope,
     )
 )
 register(
