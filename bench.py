@@ -25,6 +25,14 @@ Two measurements, one JSON line:
    digest path is additionally bit-exactness-gated against hashlib before
    its number counts.  Digests/s is derived for the 640-byte message
    shape (11 SHA-256 blocks), compared against single-thread hashlib.
+
+Artifacts are crash-proof: besides the final JSON line on stdout, every
+completed rung is immediately appended (fsynced) as one JSON line to
+``$BENCH_STREAM_PATH`` (default ``BENCH_stream.jsonl``), so a SIGKILL or
+driver timeout on the newest rung cannot erase the rungs that passed.
+Compile-heavy rungs run an untimed warmup first and report ``compile_s``
+separately; the ``soak`` rung samples RSS/fds/threads/disk under load
+and emits leak verdicts that ``obsv --diff`` gates.
 """
 
 import json
@@ -124,6 +132,51 @@ LIVE_MP_BATCH_SIZE = 4
 # the same stage (not against live_serial, whose run doesn't record
 # per-commit timestamps).
 LIVE_ATTACK_COPIES = 3
+
+# Soak rung: the resource-leak gate's evidence.  A small live cluster
+# (pipelined executor, no fsync floor — the soak watches resources, not
+# latency) runs under continuous client traffic for BENCH_SOAK_S seconds
+# while obsv.resources samples RSS, fd count, thread count, and
+# WAL/reqstore on-disk bytes; the rung reports least-squares leak
+# verdicts that `obsv --diff` turns into a PR gate alongside the p95
+# gates.
+SOAK_NODES = 4
+# Deliberately light load: all four consumers share one GIL, and pushing
+# the cluster to saturation starves whichever node loses the scheduling
+# race until transport queues overflow and it wedges — the soak measures
+# resource *trends* under steady traffic, not peak throughput.
+SOAK_CLIENTS = 4
+SOAK_BATCH_SIZE = 10
+SOAK_WINDOW = 4  # outstanding reqs per client, below the client width
+SOAK_PUSH_S = 0.25
+DEFAULT_SOAK_S = 30.0
+
+
+def sha256_microbench_warmup():
+    """Compile both chain kernels and the Pallas digest shape before the
+    timed microbench: the stage's ``compile_s`` is this function's wall,
+    its ``seconds`` the steady-state reps alone."""
+    import jax
+
+    from mirbft_tpu.ops.batching import pack_preimages
+    from mirbft_tpu.ops.sha256 import sha256_chain_checksum
+    from mirbft_tpu.ops.sha256_pallas import (
+        sha256_chain_checksum_pallas,
+        sha256_digest_words_pallas,
+    )
+
+    rng = np.random.default_rng(1)
+    block = jax.device_put(
+        rng.integers(0, 2**32, size=(CHAIN_BATCH, 16), dtype=np.uint32)
+    )
+    np.asarray(sha256_chain_checksum(block, iters=CHAIN_ITERS))
+    np.asarray(sha256_chain_checksum_pallas(block, iters=CHAIN_ITERS))
+    packed = pack_preimages([rng.bytes(MSG_BYTES)], batch_floor=1024)
+    np.asarray(
+        sha256_digest_words_pallas(
+            packed.blocks, packed.n_blocks, interpret=False
+        )
+    )
 
 
 def kernel_microbench():
@@ -238,6 +291,19 @@ def warm_kernel_shapes(plane):
         blocks = jnp.zeros((rows, bucket, 16), dtype=jnp.uint32)
         n = jnp.ones((rows,), dtype=jnp.int32)
         np.asarray(sha256_digest_words(blocks, n))
+
+
+def ed25519_microbench_warmup(batch: int = 4096):
+    """Compile the Pallas verify pipeline for the microbench's batch
+    shape (a minutes-scale Mosaic compile on a cold cache) outside the
+    timed window."""
+    from mirbft_tpu.crypto import ed25519_host as ed_host
+    from mirbft_tpu.ops.ed25519_pallas import verify_batch_pallas
+
+    seed = (0).to_bytes(32, "little")
+    msg = b"bench-warmup"
+    pk, sig = ed_host.public_key(seed), ed_host.sign(seed, msg)
+    assert all(verify_batch_pallas([pk] * batch, [msg] * batch, [sig] * batch))
 
 
 def ed25519_microbench(batch: int = 4096):
@@ -543,6 +609,56 @@ class _MemChainLog:
         return self.chain
 
 
+class _SoakChainLog:
+    """Chain log for the soak rung with O(outstanding-window) commit
+    accounting: per client, the contiguous committed prefix (``floor`` =
+    next uncommitted req_no) plus the sparse set of out-of-order commits
+    above it.  _MemChainLog's ever-growing commit set/latency map is fine
+    for a fixed-size rung but would itself read as an RSS leak over a long
+    soak — the harness must not trip the gate it implements."""
+
+    def __init__(self, clients):
+        import hashlib
+
+        self._hashlib = hashlib
+        self.chain = b""
+        self.total = 0
+        self._floor = {cid: 0 for cid in clients}
+        self._above = {cid: set() for cid in clients}
+
+    def apply(self, q_entry) -> None:
+        for ack in q_entry.requests:
+            h = self._hashlib.sha256()
+            h.update(self.chain)
+            h.update(ack.digest)
+            self.chain = h.digest()
+            floor = self._floor.get(ack.client_id)
+            if floor is None or ack.req_no < floor:
+                continue
+            above = self._above[ack.client_id]
+            if ack.req_no in above:
+                continue
+            above.add(ack.req_no)
+            self.total += 1
+            while floor in above:
+                above.discard(floor)
+                floor += 1
+            self._floor[ack.client_id] = floor
+
+    def snap(self, network_config, clients_state) -> bytes:
+        return self.chain
+
+    def committed(self, cid: int) -> int:
+        return self._floor[cid] + len(self._above[cid])
+
+    def missing(self, cid: int, below: int) -> list:
+        """Uncommitted req_nos < ``below``, O(outstanding window)."""
+        above = self._above[cid]
+        return [
+            rn for rn in range(self._floor[cid], below) if rn not in above
+        ]
+
+
 def live_cluster_rate(kind: str, flood_copies: int = 0, detailed: bool = False):
     """Committed reqs/sec on a real loopback TCP cluster under executor
     ``kind``: LIVE_NODES real Nodes (serializer threads, real sockets,
@@ -793,6 +909,261 @@ def live_mp_run(kind: str):
         supervisor.teardown()
 
 
+def soak_run(duration_s=None, sample_interval_s=0.5, registry=None):
+    """Resource-leak soak: SOAK_NODES real Nodes over loopback TCP with
+    on-disk WAL/reqstore (pipelined executor, no emulated fsync floor)
+    under continuous windowed client traffic for ``duration_s``, while an
+    obsv ResourceSampler tracks RSS, open fds, thread count, and the
+    WAL/reqstore tree sizes.
+
+    Returns ``{"seconds", "commits", "samples", "leak": {metric:
+    verdict}}`` where each verdict is obsv.resources.leak_verdict's
+    least-squares ``flat``/``growing`` call.  The settle-in head of every
+    series is dropped before the fit: ramping from an empty store to
+    steady state reads as growth that isn't a leak."""
+    import shutil
+    import tempfile
+
+    from mirbft_tpu import pb
+    from mirbft_tpu.obsv.metrics import Registry
+    from mirbft_tpu.obsv.resources import ResourceSampler, leak_verdict
+    from mirbft_tpu.runtime import (
+        Config,
+        FileRequestStore,
+        FileWal,
+        Node,
+        TcpTransport,
+        build_processor,
+    )
+    from mirbft_tpu.runtime.node import (
+        NodeStopped,
+        standard_initial_network_state,
+    )
+
+    if duration_s is None:
+        duration_s = float(os.environ.get("BENCH_SOAK_S", DEFAULT_SOAK_S))
+    if registry is None:
+        registry = Registry()
+    root = tempfile.mkdtemp(prefix="mirbft-bench-soak-")
+    clients = list(range(1, SOAK_CLIENTS + 1))
+    state = standard_initial_network_state(SOAK_NODES, clients)
+    # Frequent stable checkpoints on purpose: WAL truncation and client
+    # GC are part of steady state — without them disk growth is by
+    # design, and the leak fit would (correctly) flag it.  Planned epoch
+    # rotation stays deferred past the soak (the chaos campaign owns
+    # rotation); only max_epoch_length moves, so rotation noise cannot
+    # masquerade as a resource trend.
+    state.config.checkpoint_interval = 10
+    state.config.max_epoch_length = 100 * state.config.checkpoint_interval
+    nodes, transports, processors = [], [], []
+    wals, stores, logs = [], [], []
+    stop = threading.Event()
+    threads = []
+    failures: list = []
+
+    def consume(node, processor, tick_s=LIVE_TICK_S):
+        last_tick = time.monotonic()
+        try:
+            while not stop.is_set():
+                actions = node.ready(timeout=0.01)
+                if actions is not None:
+                    results = processor.process(actions)
+                    if results.digests or results.checkpoints:
+                        node.add_results(results)
+                now = time.monotonic()
+                if now - last_tick >= tick_s:
+                    last_tick = now
+                    node.tick()
+        except NodeStopped:
+            pass
+        except Exception as exc:  # noqa: BLE001 — surfaced as stage error
+            failures.append(exc)
+
+    sampler = ResourceSampler(
+        registry=registry,
+        interval_s=sample_interval_s,
+        dirs={
+            "wal": os.path.join(root, "wal"),
+            "reqstore": os.path.join(root, "reqs"),
+        },
+        node="bench-soak",
+    )
+    try:
+        for n in range(SOAK_NODES):
+            # All WALs under one parent (ditto reqstores) so each family
+            # is one sampled disk series.
+            wal = FileWal(os.path.join(root, "wal", f"node{n}"))
+            store = FileRequestStore(os.path.join(root, "reqs", f"node{n}"))
+            # Small reclamation quanta: the default 4MB segment/compaction
+            # thresholds never trip inside a seconds-scale soak, which
+            # would read as monotone disk growth.  Sized to the soak's
+            # ~0.7KB/s per-node write rate so rotation/compaction fire
+            # every few seconds and steady state is a sawtooth the
+            # least-squares fit sees as flat.
+            wal.segment_target = 4 * 1024
+            store.compact_min_bytes = 8 * 1024
+            app_log = _SoakChainLog(clients)
+            node = Node.start_new(
+                Config(
+                    id=n,
+                    batch_size=SOAK_BATCH_SIZE,
+                    processor="pipelined",
+                    suspect_ticks=LIVE_SUSPECT_TICKS,
+                ),
+                state,
+            )
+            transport = TcpTransport(
+                n, backoff_base=0.02, backoff_cap=0.25, dial_timeout=1.0
+            )
+            transport.serve(node)
+            processor = build_processor(
+                node, transport.link(), app_log, wal, store
+            )
+            nodes.append(node)
+            transports.append(transport)
+            processors.append(processor)
+            wals.append(wal)
+            stores.append(store)
+            logs.append(app_log)
+        for n in range(SOAK_NODES):
+            for m in range(SOAK_NODES):
+                if n != m:
+                    transports[n].connect(m, transports[m].address)
+        for n in range(SOAK_NODES):
+            thread = threading.Thread(
+                target=consume,
+                args=(nodes[n], processors[n]),
+                name=f"bench-soak-consumer-{n}",
+                daemon=True,
+            )
+            threads.append(thread)
+            thread.start()
+
+        def propose_all(cid, rn):
+            request = pb.Request(client_id=cid, req_no=rn, data=b"%d" % rn)
+            for node in nodes:
+                try:
+                    node.propose(request)
+                except (NodeStopped, ValueError):
+                    pass
+
+        sampler.start()
+        start = time.perf_counter()
+        end = start + duration_s
+        next_req = {cid: 0 for cid in clients}
+        last_push = 0.0
+        last_retry = 0.0
+        while time.perf_counter() < end:
+            if failures:
+                raise failures[0]
+            now = time.monotonic()
+            winner = max(logs, key=lambda l: l.total)
+            if now - last_push >= SOAK_PUSH_S:
+                # Sliding-window open loop: keep SOAK_WINDOW fresh
+                # requests outstanding past each client's commit count on
+                # the fastest node.
+                last_push = now
+                for cid in clients:
+                    while next_req[cid] < winner.committed(cid) + SOAK_WINDOW:
+                        propose_all(cid, next_req[cid])
+                        next_req[cid] += 1
+            if now - last_retry >= 0.5:
+                # Straggler repair, as in the live rung: acks lost in the
+                # startup connect races (or any drop) would wedge a node
+                # forever — re-propose every req_no any log is still
+                # missing below the proposed mark (below-watermark
+                # duplicates are deduplicated as PAST).
+                last_retry = now
+                for cid in clients:
+                    gaps = set()
+                    for log in logs:
+                        gaps.update(log.missing(cid, next_req[cid]))
+                    for rn in sorted(gaps):
+                        propose_all(cid, rn)
+            time.sleep(0.02)
+        elapsed = time.perf_counter() - start
+        sampler.stop()
+        series = sampler.snapshot_series()
+        leak = {
+            name: leak_verdict(samples[len(samples) // 5 :])
+            for name, samples in series.items()
+        }
+        return {
+            "seconds": round(elapsed, 1),
+            "commits": max((log.total for log in logs), default=0),
+            "samples": max((len(s) for s in series.values()), default=0),
+            "leak": leak,
+        }
+    finally:
+        sampler.stop()
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=10)
+        for processor in processors:
+            closer = getattr(processor, "close", None)
+            if closer is not None:
+                closer()
+        for transport in transports:
+            transport.close(0)
+        for node in nodes:
+            node.stop()
+        for wal in wals:
+            wal.close()
+        for store in stores:
+            store.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+class BenchStream:
+    """Crash-proof rung journal: one fsynced JSON line the moment each
+    stage finishes, so a SIGKILL (or the driver's rc=124 timeout) on the
+    newest rung cannot erase the rungs that already passed.
+
+    Line kinds: ``header`` (schema + pid), one ``stage`` line per stage
+    with its status/seconds/compile_s, and a trailing ``final`` line
+    carrying the aggregated payload.  Consumers that find no ``final``
+    line reconstruct the run from the stage lines.  Every write is
+    best-effort: a full disk must not take the bench down with it."""
+
+    SCHEMA = "mirbft-bench-stream/1"
+
+    def __init__(self, path):
+        self.path = path
+        self._fh = None
+        try:
+            self._fh = open(path, "w", encoding="utf-8")
+        except OSError:
+            return
+        self._line({"schema": self.SCHEMA, "kind": "header", "pid": os.getpid()})
+
+    def _line(self, obj) -> None:
+        if self._fh is None:
+            return
+        try:
+            self._fh.write(json.dumps(obj) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except (OSError, ValueError, TypeError):
+            pass
+
+    def stage(self, name, entry, registry) -> None:
+        seconds = registry.gauge(
+            "mirbft_bench_stage_seconds", stage=name
+        ).value
+        self._line({"kind": "stage", "stage": name, "seconds": seconds, **entry})
+
+    def final(self, payload) -> None:
+        self._line({"kind": "final", "payload": payload})
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
 class StageRunner:
     """Time-boxed stage executor under one monotonic deadline.
 
@@ -806,15 +1177,24 @@ class StageRunner:
 
     ``stage_budget_s`` (env ``BENCH_STAGE_BUDGET_S``) additionally caps
     each individual stage, so one pathological stage times out on its
-    own sub-budget instead of eating every later stage's runway."""
+    own sub-budget instead of eating every later stage's runway.
+
+    A stage may carry a ``warmup`` callable: it runs on the same worker
+    thread immediately before ``fn`` so JAX/Mosaic compiles land outside
+    the timed window — its cost is reported separately as ``compile_s``
+    (gauge ``mirbft_bench_stage_compile_seconds``) while the
+    stage-seconds gauge times ``fn`` alone.  When a ``stream`` is wired,
+    every finished stage is journaled to it immediately."""
 
     # Don't bother starting a stage with less runway than this.
     MIN_RUNWAY_S = 5.0
 
-    def __init__(self, budget_s: float, registry, stage_budget_s=None):
+    def __init__(self, budget_s: float, registry, stage_budget_s=None,
+                 stream=None):
         self.deadline = time.monotonic() + budget_s
         self.registry = registry
         self.stage_budget_s = stage_budget_s
+        self.stream = stream
         self.status: dict = {}  # stage -> {"status": ..., ["detail": ...]}
         # The stage currently executing (None between stages): the hard
         # watchdog reads this to name the culprit when join() itself is
@@ -824,8 +1204,18 @@ class StageRunner:
     def remaining(self) -> float:
         return self.deadline - time.monotonic()
 
-    def run(self, name: str, fn, enabled: bool = True, detail: str = ""):
+    def run(self, name: str, fn, enabled: bool = True, detail: str = "",
+            warmup=None):
         """Run one stage; returns fn() or None (skipped/timeout/error)."""
+        try:
+            return self._run(name, fn, enabled, detail, warmup)
+        finally:
+            if self.stream is not None:
+                self.stream.stage(
+                    name, self.status.get(name, {}), self.registry
+                )
+
+    def _run(self, name, fn, enabled, detail, warmup):
         entry: dict = {"status": "skipped"}
         if detail:
             entry["detail"] = detail
@@ -843,7 +1233,15 @@ class StageRunner:
 
         def work():
             try:
+                if warmup is not None:
+                    warm_start = time.perf_counter()
+                    warmup()
+                    box["compile_s"] = round(
+                        time.perf_counter() - warm_start, 3
+                    )
+                fn_start = time.perf_counter()
                 box["result"] = fn()
+                box["fn_s"] = round(time.perf_counter() - fn_start, 3)
             except BaseException as exc:  # report, never crash the bench
                 box["error"] = f"{type(exc).__name__}: {exc}"
 
@@ -857,8 +1255,13 @@ class StageRunner:
             thread.join(timeout=runway)
         finally:
             self.current = None
+        if "compile_s" in box:
+            entry["compile_s"] = box["compile_s"]
+            self.registry.gauge(
+                "mirbft_bench_stage_compile_seconds", stage=name
+            ).set(box["compile_s"])
         self.registry.gauge("mirbft_bench_stage_seconds", stage=name).set(
-            round(time.perf_counter() - start, 3)
+            box.get("fn_s", round(time.perf_counter() - start, 3))
         )
         if thread.is_alive():
             entry["status"] = "timeout"
@@ -902,11 +1305,13 @@ class Watchdog:
     ``emit``/``exit_fn`` are injectable so the regression test can run a
     deliberately wedged stage without killing the test process."""
 
-    def __init__(self, runner, deadline_s, emit=None, exit_fn=None):
+    def __init__(self, runner, deadline_s, emit=None, exit_fn=None,
+                 stream=None):
         self.runner = runner
         self.deadline_s = deadline_s
         self.emit = emit if emit is not None else print
         self.exit_fn = exit_fn if exit_fn is not None else os._exit
+        self.stream = stream
         self.fired = threading.Event()
         self._cancelled = threading.Event()
         self._thread = threading.Thread(
@@ -948,6 +1353,8 @@ class Watchdog:
             "stages": stages,
         }
         try:
+            if self.stream is not None:
+                self.stream.final(payload)
             self.emit(json.dumps(payload))
             sys.stdout.flush()
         finally:
@@ -990,12 +1397,18 @@ def main() -> int:
     from mirbft_tpu.obsv.metrics import Registry
 
     registry = Registry()
+    stream = BenchStream(
+        os.environ.get("BENCH_STREAM_PATH", "BENCH_stream.jsonl")
+    )
     runner = StageRunner(
         budget_s,
         registry,
         stage_budget_s=float(stage_budget) if stage_budget else None,
+        stream=stream,
     )
-    watchdog = Watchdog(runner, deadline_s=budget_s + WATCHDOG_GRACE_S)
+    watchdog = Watchdog(
+        runner, deadline_s=budget_s + WATCHDOG_GRACE_S, stream=stream
+    )
     watchdog.start()
     if threading.current_thread() is threading.main_thread() and hasattr(
         signal, "SIGALRM"
@@ -1016,6 +1429,13 @@ def main() -> int:
     )
     live_pipelined = runner.run(
         "live_pipelined", lambda: live_cluster_rate("pipelined")
+    )
+    soak_s = float(os.environ.get("BENCH_SOAK_S", DEFAULT_SOAK_S))
+    soak = runner.run(
+        "soak",
+        lambda: soak_run(duration_s=soak_s, registry=registry),
+        enabled=soak_s > 0,
+        detail="BENCH_SOAK_S=0",
     )
     attack = runner.run("live_under_attack", live_attack_run)
     (
@@ -1079,11 +1499,19 @@ def main() -> int:
     if ladder is not None and host is not None:
         consistent = events == host_events and chain == host_chain
 
-    micro = runner.run("sha256_microbench", kernel_microbench)
+    micro = runner.run(
+        "sha256_microbench",
+        kernel_microbench,
+        warmup=sha256_microbench_warmup,
+    )
     xla_rate, pallas_rate, kernel_digest_rate, host_rate = (
         micro if micro is not None else (None,) * 4
     )
-    ed = runner.run("ed25519_microbench", ed25519_microbench)
+    ed = runner.run(
+        "ed25519_microbench",
+        ed25519_microbench,
+        warmup=ed25519_microbench_warmup,
+    )
     ed_kernel_rate, ed_host_rate = ed if ed is not None else (None, None)
     # Rung 3 after the microbench: its verify chunks reuse the freshly
     # compiled Pallas pipeline shapes, so the timed run is all steady
@@ -1242,6 +1670,17 @@ def main() -> int:
             "HEAVY-gated correctness tier)"
         ),
         "rung5_engine_events": rung5_events,
+        # Soak rung: resource series + least-squares leak verdicts;
+        # `obsv --diff` fails the run when any verdict is "growing" —
+        # RSS/fd/disk regressions gate PRs exactly like p95 regressions.
+        "soak": soak,
+        "soak_config": (
+            f"{SOAK_NODES} nodes f={(SOAK_NODES - 1) // 3}, "
+            f"{SOAK_CLIENTS} clients, sliding window {SOAK_WINDOW}, "
+            f"pipelined executor, {soak_s:.0f}s "
+            "(BENCH_SOAK_S), on-disk WAL/reqstore, obsv resource "
+            "sampler @0.5s"
+        ),
         "bench_budget_s": budget_s,
         "bench_stage_budget_s": runner.stage_budget_s,
         "stages": runner.stage_report(),
@@ -1282,6 +1721,8 @@ def main() -> int:
     # but are not fatal; only a ladder consistency violation — a
     # correctness failure, not an environment limitation — fails the rc.
     watchdog.cancel()
+    stream.final(payload)
+    stream.close()
     print(json.dumps(payload))
     return 1 if consistent is False else 0
 

@@ -1,5 +1,10 @@
 """CLI entry: ``python -m mirbft_tpu.chaos [--seed N] [--seeds K] [--smoke]
-[--live] [--adversary] [--cluster {threads,mp}] [--only S]``.
+[--live] [--adversary] [--cluster {threads,mp}] [--only S] [--json]``.
+
+``--json`` replaces the human report with one JSON document per
+campaign; each failed scenario carries a ``dump`` field pointing at the
+flight-recorder segment flushed when its invariant fired (feed the
+directory to ``python -m mirbft_tpu.obsv --postmortem``).
 
 ``--live`` runs the campaign against a real loopback TCP cluster
 instead of the deterministic testengine; ``--smoke`` selects each
@@ -16,6 +21,7 @@ every seed of the sweep, when ``--seeds`` > 1)."""
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from .live import run_live_campaign
@@ -96,6 +102,13 @@ def main(argv=None) -> int:
         "them",
     )
     parser.add_argument(
+        "--json",
+        action="store_true",
+        help="print one machine-readable JSON document per campaign "
+        "instead of the human report; failed scenarios carry the flight "
+        "recorder dump path under 'dump'",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     args = parser.parse_args(argv)
@@ -151,7 +164,10 @@ def main(argv=None) -> int:
             )
         else:
             campaign = run_campaign(scenarios, seed=seed)
-        print(campaign.report(), flush=True)
+        if args.json:
+            print(json.dumps(campaign.to_dict(), indent=2), flush=True)
+        else:
+            print(campaign.report(), flush=True)
         all_passed = all_passed and campaign.passed
         good_campaigns += campaign.passed
     if args.seeds > 1:

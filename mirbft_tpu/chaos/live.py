@@ -80,11 +80,13 @@ from .invariants import (
     check_no_fork,
     check_no_fork_under_equivocation,
 )
+from ..obsv.recorder import FlightRecorder
 from .runner import (
     FIRST_WORKING_EPOCH,
     ROTATION_BUCKETS,
     CampaignResult,
     ScenarioResult,
+    dump_on_violation,
 )
 from .scenarios import Scenario, live_matrix
 
@@ -1431,6 +1433,17 @@ def run_live_scenario(
             trace=False,
         )
     registry = hooks.metrics
+    # Reuse the session flight recorder if one is wired; otherwise lend a
+    # scenario-local ring to the hooks so node milestones land in the
+    # postmortem dump attached on invariant failure.
+    recorder = hooks.recorder
+    own_recorder = recorder is None
+    if own_recorder:
+        recorder = FlightRecorder(f"chaos-live-{scenario.name}")
+        hooks.recorder = recorder
+    recorder.record_note(
+        "scenario.start", args={"scenario": scenario.name, "seed": seed}
+    )
     result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
     epoch_active_before = _epoch_active_total(registry)
     cluster = LiveCluster(
@@ -1502,6 +1515,9 @@ def run_live_scenario(
             result.passed = True
         except InvariantViolation as violation:
             result.violation = str(violation)
+            result.dump = dump_on_violation(
+                recorder, scenario.name, seed, violation
+            )
         result.events = cluster.events_fired
         result.sim_ms = cluster.now_ms() if cluster._start is not None else 0
         result.commits = sum(
@@ -1528,6 +1544,8 @@ def run_live_scenario(
             result.counters["dropped_fault"] = dropped_fault
     finally:
         cluster.teardown()
+        if own_recorder and hooks.recorder is recorder:
+            hooks.recorder = None
         if own_hooks:
             hooks.disable()
     return result

@@ -8,10 +8,13 @@ which is what gives bounded-recovery its evidence."""
 
 from __future__ import annotations
 
+import os
+import tempfile
 from dataclasses import dataclass, field
 
 from ..obsv import hooks
 from ..obsv.metrics import Registry
+from ..obsv.recorder import FlightRecorder
 from ..testengine.engine import BasicRecorder
 from .invariants import (
     CrashSnapshot,
@@ -47,6 +50,7 @@ class ScenarioResult:
     sim_ms: int = 0
     commits: int = 0
     violation: str = ""
+    dump: str = ""  # flight-recorder segment path on invariant failure
     counters: dict = field(default_factory=dict)
 
     def line(self) -> str:
@@ -55,11 +59,25 @@ class ScenarioResult:
             f" {key}={value}" for key, value in sorted(self.counters.items())
         )
         tail = f" [{self.violation}]" if self.violation else ""
+        dump = f" dump={self.dump}" if self.dump else ""
         return (
             f"{status} {self.name:<28} seed={self.seed} "
             f"events={self.events} sim={self.sim_ms}ms "
-            f"commits={self.commits}{extra}{tail}"
+            f"commits={self.commits}{extra}{tail}{dump}"
         )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "passed": self.passed,
+            "events": self.events,
+            "sim_ms": self.sim_ms,
+            "commits": self.commits,
+            "violation": self.violation,
+            "dump": self.dump,
+            "counters": dict(self.counters),
+        }
 
 
 @dataclass
@@ -79,6 +97,51 @@ class CampaignResult:
             f"scenarios passed"
         )
         return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        """Machine-readable campaign summary (``chaos --json``); each
+        failed scenario's ``dump`` points at its postmortem segment."""
+        return {
+            "seed": self.seed,
+            "passed": self.passed,
+            "scenarios": [r.to_dict() for r in self.results],
+        }
+
+
+def _dump_dir() -> str:
+    """Where invariant-failure flight dumps land:
+    ``$MIRBFT_CHAOS_DUMP_DIR`` when set, else a per-process tempdir."""
+    configured = os.environ.get("MIRBFT_CHAOS_DUMP_DIR")
+    if configured:
+        os.makedirs(configured, exist_ok=True)
+        return configured
+    root = os.path.join(
+        tempfile.gettempdir(), f"mirbft-chaos-dumps-{os.getpid()}"
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def dump_on_violation(recorder, scenario_name, seed, violation) -> str:
+    """Record the failure note and flush the ring to a segment; returns
+    the segment path ('' when the flush could not land)."""
+    recorder.record_note(
+        "invariant.violation",
+        args={
+            "scenario": scenario_name,
+            "seed": seed,
+            "violation": str(violation),
+        },
+    )
+    if not recorder.dump_dir:
+        recorder.dump_dir = os.path.join(
+            _dump_dir(), f"{scenario_name}-seed{seed}"
+        )
+        os.makedirs(recorder.dump_dir, exist_ok=True)
+    try:
+        return recorder.flush("invariant-failure") or ""
+    except OSError:
+        return ""
 
 
 def run_scenario(
@@ -127,6 +190,20 @@ def run_scenario(
     commit_times: list = []
     last_total = sum(rec._committed_counts.values())
     result = ScenarioResult(name=scenario.name, seed=seed, passed=False)
+
+    # Flight recorder: reuse the globally-wired one so the dump carries
+    # the engine's milestones; otherwise run a scenario-local ring (and,
+    # when hooks are live, lend it to them for the scenario's duration)
+    # so a violation still leaves black-box evidence behind.
+    recorder = hooks.recorder if hooks.enabled else None
+    own_recorder = recorder is None
+    if own_recorder:
+        recorder = FlightRecorder(f"chaos-{scenario.name}")
+        if hooks.enabled:
+            hooks.recorder = recorder
+    recorder.record_note(
+        "scenario.start", args={"scenario": scenario.name, "seed": seed}
+    )
 
     censor_manglers = [m for m in manglers if hasattr(m, "censored_pairs")]
     # (client_id, req_no) -> epoch rotations (relative to the first
@@ -246,6 +323,12 @@ def run_scenario(
         result.passed = True
     except InvariantViolation as violation:
         result.violation = str(violation)
+        result.dump = dump_on_violation(
+            recorder, scenario.name, seed, violation
+        )
+    finally:
+        if own_recorder and hooks.recorder is recorder:
+            hooks.recorder = None
 
     result.events = rec.event_count
     result.sim_ms = rec.now

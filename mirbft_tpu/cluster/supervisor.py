@@ -335,6 +335,39 @@ class ClusterSupervisor:
             handle.log_file.close()
             handle.log_file = None
         handle.process = None
+        if not graceful:
+            # SIGKILL gave the worker no chance to flush; its continuous
+            # autoflush did, so reap the newest committed segment and
+            # stamp how the process actually died.
+            self._reap_flight(handle, "sigkill-reaped")
+
+    def _reap_flight(self, handle: _NodeHandle, reason: str) -> str | None:
+        """Annotate the node's newest flight segment with the real cause
+        of death (the worker believed its last flush was routine).
+        Returns the segment path, or None when the node never flushed."""
+        from ..obsv.recorder import annotate_dump, load_dumps
+
+        dumps = load_dumps(os.path.join(handle.dir, "flight"))
+        entry = dumps.get(handle.node_id)
+        if entry is None:
+            return None
+        path, _dump = entry
+        annotate_dump(path, reason=reason)
+        return path
+
+    def flight_dumps(self) -> dict:
+        """Newest flight-recorder segment per node id (postmortem
+        input): feed ``self.root`` — or any one path's directory — to
+        ``python -m mirbft_tpu.obsv --postmortem``."""
+        from ..obsv.recorder import load_dumps
+
+        out = {}
+        for handle in self.nodes:
+            dumps = load_dumps(os.path.join(handle.dir, "flight"))
+            entry = dumps.get(handle.node_id)
+            if entry is not None:
+                out[handle.node_id] = entry[0]
+        return out
 
     def restart(self, node_id: int, timeout_s: float = 60.0) -> None:
         """Respawn a killed node from its on-disk state, on its original

@@ -47,6 +47,8 @@ from .. import pb
 from ..chaos.live import DurableChainLog
 from ..obsv import hooks
 from ..obsv.metrics import Registry
+from ..obsv.recorder import FlightRecorder
+from ..obsv.resources import ResourceSampler
 from ..runtime import (
     Config,
     FileRequestStore,
@@ -96,12 +98,34 @@ class Worker:
         self._stop = threading.Event()
         os.makedirs(self.dir, exist_ok=True)
 
-        hooks.enable(registry=Registry(), trace=False)
+        registry = Registry()
+        # The black box: a bounded ring continuously autoflushed to
+        # atomic segments under <dir>/flight/, so even kill -9 (which
+        # skips _shutdown entirely) leaves a recent dump for the
+        # supervisor to reap and `obsv --postmortem` to merge.
+        self.recorder = FlightRecorder(
+            self.node_id,
+            dump_dir=os.path.join(self.dir, "flight"),
+            capacity=int(spec.get("flight_capacity", 512)),
+            autoflush_every=int(spec.get("flight_autoflush", 256)),
+            registry=registry,
+        )
+        hooks.enable(registry=registry, trace=False, recorder=self.recorder)
         self.app_log = DurableChainLog(
             os.path.join(self.dir, "app.log"), self.node_id, timestamps=True
         )
         self.wal = FileWal(os.path.join(self.dir, "wal"))
         self.reqstore = FileRequestStore(os.path.join(self.dir, "reqs"))
+        self.sampler = ResourceSampler(
+            registry=registry,
+            recorder=self.recorder,
+            interval_s=float(spec.get("resource_interval_s", 1.0)),
+            dirs={
+                "wal": os.path.join(self.dir, "wal"),
+                "reqstore": os.path.join(self.dir, "reqs"),
+            },
+            node=self.node_id,
+        ).start()
         config = Config(
             id=self.node_id,
             batch_size=int(spec.get("batch_size", 1)),
@@ -197,6 +221,14 @@ class Worker:
         )
         if hasattr(self.processor, "on_results"):
             self.processor.on_results = self._capture_checkpoints
+        # The transport's hello handshake measured peer clock offsets;
+        # stamp them into the recorder so --postmortem aligns this
+        # node's dump with its peers' exactly like live trace merging.
+        self.recorder.set_clock_offsets(self.transport.clock_offsets())
+        self.recorder.record_note("worker.ready", args={"pid": os.getpid()})
+        # Commit a baseline segment now: a SIGKILL that lands before the
+        # first autoflush threshold must still find a dump to annotate.
+        self.recorder.flush("ready")
         self.node.set_ready(True)
 
     # -- checkpoints / state transfer ---------------------------------------
@@ -295,6 +327,14 @@ class Worker:
         self._stop.set()
 
     def _shutdown(self, graceful: bool) -> None:
+        self.sampler.stop()
+        try:
+            self.recorder.record_note(
+                "worker.shutdown", args={"graceful": graceful}
+            )
+            self.recorder.flush("exit" if graceful else "sigterm")
+        except OSError:
+            pass  # a full disk must not block the rest of teardown
         closer = getattr(self.processor, "close", None)
         if closer is not None:
             try:

@@ -74,9 +74,10 @@ _TOMBSTONE = object()
 
 
 class StableList:
-    """Singly linked append-only list.  Removal tombstones the node and
-    unlinks it: live iterators already holding the node keep walking its
-    ``next`` chain; fresh iterators never see it."""
+    """Singly linked append-only list.  Removal tombstones the node; the
+    next traversal splices tombstone runs out of the chain so they can be
+    collected.  Live iterators already holding a spliced node keep walking
+    its ``next`` chain; fresh iterators never see it."""
 
     def __init__(self):
         self._head = _StableNode()  # sentinel
@@ -100,6 +101,18 @@ class StableIterator:
         node = self._prev.next
         while node is not None and node.value is _TOMBSTONE:
             node = node.next
+        if node is not self._prev.next and self._prev.value is not _TOMBSTONE:
+            # Splice the tombstone run out of the chain so the nodes can be
+            # collected (tombstoning alone leaks one node per removed
+            # request, forever).  Safe for concurrent iterators: a spliced
+            # node keeps its own ``next`` pointer, so anyone parked on it
+            # rejoins the live chain here.  Only a live anchor may splice —
+            # a tombstoned ``_prev`` may itself already be off-chain, and
+            # writing through it (or pointing ``_tail`` at it) would orphan
+            # the suffix.
+            if node is None:
+                self._list._tail = self._prev
+            self._prev.next = node
         return node
 
     def has_next(self) -> bool:

@@ -13,7 +13,12 @@ Tool modes (mutually exclusive with the run):
   Perfetto-loadable file with per-node process lanes (obsv/merge.py).
 - ``--diff A B [--threshold PCT]`` — compare two trace/bench artifacts;
   prints a human summary plus one machine-readable JSON line, exits
-  nonzero on a >= threshold regression (obsv/diff.py).
+  nonzero on a >= threshold regression or a ``growing`` resource-leak
+  verdict in B (obsv/diff.py).
+- ``--postmortem DIR [--out PATH]`` — merge every node's newest flight
+  recorder dump under DIR into one clock-aligned causal timeline ending
+  at the failure (obsv/recorder.py); ``--out`` also writes the merged
+  Chrome trace for Perfetto.
 """
 
 from __future__ import annotations
@@ -59,8 +64,19 @@ def main(argv=None) -> int:
                         help="diff mode: compare two trace/bench artifacts")
     parser.add_argument("--threshold", type=float, default=None,
                         help="regression threshold percent for --diff")
+    parser.add_argument("--postmortem", metavar="DIR",
+                        help="postmortem mode: merge flight recorder "
+                        "dumps under DIR into one causal timeline")
+    parser.add_argument("--out", metavar="PATH",
+                        help="write the merged postmortem trace here "
+                        "(--postmortem only)")
+    parser.add_argument("--limit", type=int, default=200,
+                        help="timeline lines to print (--postmortem, "
+                        "default 200)")
     args = parser.parse_args(argv)
 
+    if args.postmortem:
+        return _postmortem_main(args)
     if args.diff:
         return _diff_main(args)
     if args.merge:
@@ -78,6 +94,29 @@ def _diff_main(args) -> int:
     print(render_report(report))
     print(json.dumps(report))
     return 0 if report["ok"] else 1
+
+
+def _postmortem_main(args) -> int:
+    from .recorder import postmortem
+
+    try:
+        result = postmortem(args.postmortem, out_path=args.out,
+                            limit=args.limit)
+    except FileNotFoundError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    dumps = result["dumps"]
+    print(f"postmortem: {len(dumps)} node dump(s) under {args.postmortem}")
+    for node, path in sorted(dumps.items()):
+        print(f"  node {node}: {path}")
+    print()
+    print("causal timeline (clock-aligned, oldest first, ends at failure):")
+    print(result["timeline"] or "  (no entries)")
+    if args.out:
+        events = len(result["merged"].get("traceEvents", ()))
+        print(f"\nmerged trace ({events} events) written to {args.out} "
+              "(open in ui.perfetto.dev)")
+    return 0
 
 
 def _merge_main(args) -> int:

@@ -26,6 +26,12 @@ Direction is inferred per series name: throughput-like series
 (``per_sec``, ``rate``, ``count``, ``events``) regress when they *drop*;
 latency-like series (``p50/p95/p99``, ``ms``, ``seconds``, ``wall``)
 regress when they *rise*; anything else is reported but never gates.
+
+Resource-leak gating: when artifact B carries leak verdicts (a bench
+JSON with a ``soak.leak`` mapping, or a standalone ``mirbft-soak/…``
+artifact), any metric whose verdict is ``growing`` is a
+``leak_failures`` entry and fails the diff exactly like a p95
+regression — RSS or on-disk growth gates PRs, not just speed.
 """
 
 from __future__ import annotations
@@ -102,7 +108,31 @@ def extract_series(artifact):
     loadgen_doc = artifact.get("loadgen")
     if isinstance(loadgen_doc, dict):
         series.update(_loadgen_series(loadgen_doc, prefix="loadgen."))
+    for metric, verdict in sorted(extract_leaks(artifact).items()):
+        for key in ("first", "last", "rel_pct_per_min"):
+            value = verdict.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                series[f"soak.{metric}.{key}"] = float(value)
     return series
+
+
+def extract_leaks(artifact):
+    """``{metric: leak_verdict_dict}`` from a bench or soak artifact.
+
+    Bench JSON nests the verdicts under ``soak.leak``; a standalone
+    soak artifact (``schema: mirbft-soak/…``) carries ``leak`` at the
+    top level.  Anything else yields an empty mapping.
+    """
+    if str(artifact.get("schema", "")).startswith("mirbft-soak"):
+        leaks = artifact.get("leak") or {}
+    else:
+        soak = artifact.get("soak")
+        leaks = (soak.get("leak") or {}) if isinstance(soak, dict) else {}
+    return {
+        name: verdict
+        for name, verdict in leaks.items()
+        if isinstance(verdict, dict)
+    }
 
 
 def diff_series(a, b, threshold_pct=DEFAULT_THRESHOLD_PCT):
@@ -164,8 +194,34 @@ def diff_files(path_a, path_b, threshold_pct=DEFAULT_THRESHOLD_PCT):
     report = diff_series(
         extract_series(a), extract_series(b), threshold_pct=threshold_pct
     )
+    apply_leak_gate(report, b)
     report["a"] = str(path_a)
     report["b"] = str(path_b)
+    return report
+
+
+def apply_leak_gate(report, artifact_b):
+    """Fold B's leak verdicts into a diff report (in place).
+
+    A ``growing`` verdict in the *new* artifact fails the gate
+    regardless of what A looked like — a leak is absolute, not
+    relative.  Verdicts from A are irrelevant: they gated A's own PR.
+    """
+    failures = []
+    for metric, verdict in sorted(extract_leaks(artifact_b).items()):
+        if verdict.get("verdict") == "growing":
+            failures.append(
+                {
+                    "series": f"soak.{metric}",
+                    "verdict": "growing",
+                    "confidence": verdict.get("confidence"),
+                    "rel_pct_per_min": verdict.get("rel_pct_per_min"),
+                    "first": verdict.get("first"),
+                    "last": verdict.get("last"),
+                }
+            )
+    report["leak_failures"] = failures
+    report["ok"] = report["ok"] and not failures
     return report
 
 
@@ -185,10 +241,21 @@ def render_report(report):
             f"  ok        {entry['series']}: {entry['a']:g} -> {entry['b']:g} "
             f"({entry['delta_pct']:+.1f}% worse)"
         )
+    for entry in report.get("leak_failures", ()):
+        lines.append(
+            f"  LEAK      {entry['series']}: {entry['first']:g} -> "
+            f"{entry['last']:g} ({entry['rel_pct_per_min']:+.1f}%/min, "
+            f"confidence {entry['confidence']:.2f})"
+        )
     lines.append(
         f"  unchanged: {len(report['unchanged'])}  "
         f"informational: {len(report['informational'])}  "
         f"only-in-one: {len(report['only_a']) + len(report['only_b'])}"
     )
-    lines.append("VERDICT: " + ("ok" if report["ok"] else "REGRESSION"))
+    verdict = "ok"
+    if report["regressions"]:
+        verdict = "REGRESSION"
+    elif report.get("leak_failures"):
+        verdict = "LEAK"
+    lines.append("VERDICT: " + verdict)
     return "\n".join(lines)

@@ -9,6 +9,9 @@ A tiny ``http.server`` ThreadingHTTPServer on a daemon thread serving:
 - ``GET /status``   — JSON produced by a caller-supplied callable
   (``status.state_machine_status(...).to_json()`` on the runtime node).
 - ``GET /healthz``  — liveness: 200 ``{"ok": true}`` while serving.
+- ``GET /dump``     — flush the node's flight recorder to an on-disk
+  segment and return its path (503 when no recorder is wired); the
+  operator-triggered counterpart of the crash-path auto-dump.
 
 Off by default: the runtime node only starts one when
 ``Config.metrics_port`` is set (0 binds an ephemeral port — the test
@@ -39,10 +42,12 @@ class ObsvExporter:
         registry_fn=None,
         status_fn=None,
         node_id=None,
+        dump_fn=None,
     ):
         self._registry_fn = registry_fn
         self._status_fn = status_fn
         self._node_id = node_id
+        self._dump_fn = dump_fn
         self._closed = False
         # Reported by /healthz.  True by default (a node that serves is
         # live); the cluster runner's worker flips it False before wiring
@@ -64,6 +69,8 @@ class ObsvExporter:
                         body, ctype, code = exporter._status()
                     elif self.path == "/healthz":
                         body, ctype, code = exporter._healthz()
+                    elif self.path == "/dump":
+                        body, ctype, code = exporter._dump()
                     else:
                         body, ctype, code = "not found\n", "text/plain", 404
                 except Exception as exc:  # noqa: BLE001 — scrape must not kill the node
@@ -110,6 +117,16 @@ class ObsvExporter:
         if not isinstance(status, str):
             status = json.dumps(status)
         return status, "application/json", 200
+
+    def _dump(self):
+        path = self._dump_fn() if self._dump_fn else None
+        if path is None:
+            return (
+                json.dumps({"error": "no flight recorder wired"}),
+                "application/json",
+                503,
+            )
+        return json.dumps({"ok": True, "path": path}), "application/json", 200
 
     def _healthz(self):
         body = {"ok": True, "ready": bool(self.ready)}

@@ -31,6 +31,7 @@ from .metrics import CardinalityError
 enabled = False
 metrics = None  # Registry when enabled, else None
 tracer = None  # Tracer when tracing was requested, else None
+recorder = None  # FlightRecorder when wired, else None
 sim_now = None  # simulated ms (testengine runs), None under the runtime
 sample_rate = None  # span sampling rate in (0, 1], None = keep everything
 
@@ -40,7 +41,13 @@ sample_rate = None  # span sampling rate in (0, 1], None = keep everything
 _epoch_change_started: dict = {}
 
 
-def enable(registry=None, trace=False, sample_rate=None, sample_seed=0):
+def enable(
+    registry=None,
+    trace=False,
+    sample_rate=None,
+    sample_seed=0,
+    recorder=None,
+):
     """Turn observability on.  Returns ``(metrics, tracer)``.
 
     ``registry`` defaults to a fresh Registry; ``trace=True`` also
@@ -48,7 +55,9 @@ def enable(registry=None, trace=False, sample_rate=None, sample_seed=0):
     counters, so it is opt-in even when metrics are on).
     ``sample_rate`` keeps roughly that fraction of ph:"X" spans via a
     deterministic seed-derived stride (see trace.SpanSampler); it never
-    touches milestones or flow events.
+    touches milestones or flow events.  ``recorder`` optionally wires a
+    :class:`~mirbft_tpu.obsv.recorder.FlightRecorder` so milestones and
+    StateEvents also land in the black-box ring (see obsv/recorder.py).
     """
     global enabled, metrics, tracer, sim_now
     from .metrics import Registry
@@ -61,6 +70,7 @@ def enable(registry=None, trace=False, sample_rate=None, sample_seed=0):
     tracer = Tracer(sampler=sampler) if trace else None
     sim_now = None
     globals()["sample_rate"] = sample_rate
+    globals()["recorder"] = recorder
     _epoch_change_started.clear()
     enabled = True
     return metrics, tracer
@@ -68,10 +78,11 @@ def enable(registry=None, trace=False, sample_rate=None, sample_seed=0):
 
 def disable():
     """Restore the no-op state (instrumentation sites become one branch)."""
-    global enabled, metrics, tracer, sim_now, sample_rate
+    global enabled, metrics, tracer, recorder, sim_now, sample_rate
     enabled = False
     metrics = None
     tracer = None
+    recorder = None
     sim_now = None
     sample_rate = None
     _epoch_change_started.clear()
@@ -103,6 +114,9 @@ def milestone(name, node, seq, epoch=None, bucket=None):
             t.flow_step(name, tid=node, flow_id=f"c.{seq}")
         else:
             t.flow_milestone(name, tid=node, seq_no=seq, epoch=epoch, bucket=bucket)
+    r = recorder
+    if r is not None:
+        r.record_milestone(name, node=node, args=args)
     m = metrics
     if m is not None:
         try:
@@ -138,6 +152,9 @@ def epoch_milestone(name, node, epoch):
     if t is not None:
         t.instant(name, cat="consensus", tid=node, args=args)
         t.flow_step(name, tid=node, flow_id=f"e.{epoch}")
+    r = recorder
+    if r is not None:
+        r.record_milestone(name, node=node, args=args)
     m = metrics
     if m is not None:
         try:

@@ -37,6 +37,7 @@ import threading
 # name -> help text.  Keep sorted; tests assert every key appears in
 # docs/OBSERVABILITY.md.
 CATALOG = {
+    "mirbft_bench_stage_compile_seconds": "bench.py per-stage warmup/compile seconds (JAX/Mosaic compiles triggered before the timed window).",
     "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
     "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/malformed).",
     "mirbft_censored_commit_epochs": "Epoch rotations a censored-but-retried request needed before committing, per scenario.",
@@ -53,8 +54,15 @@ CATALOG = {
     "mirbft_epoch_events_total": "Epoch-change milestones (changing/active), by event and epoch.",
     "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
     "mirbft_proc_stage_queue_depth": "Pipelined processor: batches queued at each stage hand-off.",
+    "mirbft_recorder_overwritten_total": "Flight-recorder ring slots overwritten before ever reaching a dump.",
+    "mirbft_recorder_records_total": "Flight-recorder entries recorded, by kind (event/milestone/resource/note).",
     "mirbft_reqstore_appends_total": "Request-store record appends.",
+    "mirbft_reqstore_compactions_total": "Live intent-log compactions (dead-weight rewrites reclaiming disk).",
     "mirbft_request_duplicates_total": "Duplicate client submissions absorbed by request dedup, by reason (retired/committed/stored).",
+    "mirbft_resource_disk_bytes": "On-disk bytes under a store directory (wal/reqstore), sampled by obsv.resources.",
+    "mirbft_resource_open_fds": "Open file descriptors in this process, sampled by obsv.resources.",
+    "mirbft_resource_rss_bytes": "Resident set size of this process in bytes, sampled by obsv.resources.",
+    "mirbft_resource_threads": "Live Python threads in this process, sampled by obsv.resources.",
     "mirbft_reqstore_group_commit_batches": "Request-store sync tickets satisfied by group-commit fsyncs.",
     "mirbft_reqstore_group_sync_wait_seconds": "Per-waiter request-store group-commit latency (ticket issue to durable).",
     "mirbft_seq_milestones_total": "Consensus milestones reached, by milestone name, epoch, and bucket.",
@@ -77,6 +85,7 @@ CATALOG = {
 # outside this set, so a new dimension cannot ship undocumented (the
 # docs test checks every label name below against docs/OBSERVABILITY.md).
 CATALOG_LABELS = {
+    "mirbft_bench_stage_compile_seconds": ("stage",),
     "mirbft_bench_stage_seconds": ("stage",),
     "mirbft_byzantine_rejections_total": ("kind",),
     "mirbft_censored_commit_epochs": ("scenario",),
@@ -93,8 +102,15 @@ CATALOG_LABELS = {
     "mirbft_epoch_events_total": ("event", "epoch"),
     "mirbft_proc_phase_seconds": ("phase",),
     "mirbft_proc_stage_queue_depth": ("stage",),
+    "mirbft_recorder_overwritten_total": (),
+    "mirbft_recorder_records_total": ("kind",),
     "mirbft_reqstore_appends_total": (),
+    "mirbft_reqstore_compactions_total": (),
     "mirbft_request_duplicates_total": ("reason",),
+    "mirbft_resource_disk_bytes": ("store",),
+    "mirbft_resource_open_fds": (),
+    "mirbft_resource_rss_bytes": (),
+    "mirbft_resource_threads": (),
     "mirbft_reqstore_group_commit_batches": (),
     "mirbft_reqstore_group_sync_wait_seconds": (),
     "mirbft_reqstore_fsync_seconds": (),

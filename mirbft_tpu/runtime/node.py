@@ -16,6 +16,7 @@ import threading
 
 from .. import pb
 from ..core.state_machine import StateMachine
+from ..obsv import hooks
 from .config import Config
 from .msgfilter import MalformedMessage, pre_process
 
@@ -114,6 +115,7 @@ class Node:
                 registry_fn=self._live_registry,
                 status_fn=self._status_json,
                 node_id=config.id,
+                dump_fn=self._flight_dump,
             )
         self._thread = threading.Thread(
             target=self._run, name=f"mirbft-serializer-{config.id}", daemon=True
@@ -149,8 +151,6 @@ class Node:
         try:
             pre_process(msg, self.config)
         except MalformedMessage as err:
-            from ..obsv import hooks
-
             if hooks.enabled:
                 hooks.metrics.counter(
                     "mirbft_byzantine_rejections_total", kind=err.kind
@@ -251,8 +251,6 @@ class Node:
     # -- HTTP endpoint plumbing (runs on exporter request threads) -----------
 
     def _live_registry(self):
-        from ..obsv import hooks
-
         return hooks.metrics if hooks.enabled else None
 
     def _status_json(self):
@@ -263,6 +261,14 @@ class Node:
         except NodeStopped:
             return None
         return status.to_json() if status is not None else None
+
+    def _flight_dump(self, reason="endpoint"):
+        """Flush the wired flight recorder; None when none is wired
+        (the exporter maps that to 503)."""
+        recorder = hooks.recorder if hooks.enabled else None
+        if recorder is None:
+            return None
+        return recorder.flush(reason)
 
     def _close_exporter(self):
         if self._exporter is not None:
@@ -283,6 +289,10 @@ class Node:
     def _apply(self, event: pb.StateEvent, actions) -> None:
         if self.config.event_interceptor is not None:
             self.config.event_interceptor(event)
+        if hooks.enabled and hooks.recorder is not None:
+            hooks.recorder.record_event(
+                type(event.type).__name__, node=self.config.id
+            )
         actions.concat(self._machine.apply_event(event))
 
     def _run(self) -> None:
@@ -402,6 +412,18 @@ class Node:
             self.config.logger.error(
                 "serializer thread exiting", error=repr(err)
             )
+            # The black box outlives the crash: note the error and flush
+            # so the postmortem timeline ends at the failure.
+            try:
+                if hooks.enabled and hooks.recorder is not None:
+                    hooks.recorder.record_note(
+                        "serializer.crash",
+                        node=self.config.id,
+                        args={"error": repr(err)},
+                    )
+                    hooks.recorder.flush("serializer-crash")
+            except Exception:
+                pass  # dumping is best-effort on the crash path
         finally:
             self._stopped.set()
             for waiter in self._waiters:

@@ -467,9 +467,16 @@ class PipelinedProcessor(SerialProcessor):
 
     def _gauge(self, stage: str, q) -> None:
         if hooks.enabled:
+            depth = q.qsize()
             hooks.metrics.gauge(
                 "mirbft_proc_stage_queue_depth", stage=stage
-            ).set(q.qsize())
+            ).set(depth)
+            if hooks.recorder is not None:
+                hooks.recorder.record(
+                    "resource",
+                    "proc.queue_depth",
+                    args={"stage": stage, "depth": depth},
+                )
 
     def _q_put(self, q, stage: str, batch) -> None:
         """Blocking put with backpressure that stays responsive to stop:

@@ -56,6 +56,19 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def _tree_bytes(path: str) -> int:
+    """Sum of regular-file sizes under ``path``; races with concurrent
+    rotation/compaction count a vanished file as zero."""
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.stat(os.path.join(root, name)).st_size
+            except OSError:
+                continue
+    return total
+
+
 class CorruptWal(Exception):
     pass
 
@@ -181,6 +194,11 @@ class FileWal:
         self._active = None  # guarded-by: _lock
         self._active_size = 0  # guarded-by: _lock
         self._needs_sync = False  # guarded-by: _lock
+        # Segment rotation threshold.  Truncation can only unlink whole
+        # dead segments, so disk reclamation is quantized to this size;
+        # short soaks shrink it so steady-state disk usage sawtooths
+        # instead of growing for the whole observation window.
+        self.segment_target = _SEGMENT_TARGET
         # Fault-injection seam (chaos/live.py): called with no arguments
         # immediately before every fsync; raising OSError from it models a
         # failing disk.  None in production.
@@ -261,7 +279,7 @@ class FileWal:
                 f"non-contiguous append: {index} after {self._entries[-1][0]}"
             )
         payload = pb.encode(entry)
-        if self._active is None or self._active_size >= _SEGMENT_TARGET:
+        if self._active is None or self._active_size >= self.segment_target:
             if self._active is not None:
                 self._active.flush()
                 os.fsync(self._active.fileno())
@@ -326,6 +344,11 @@ class FileWal:
     def wait(self, token: int, timeout: float | None = None) -> bool:
         return self._group.wait(token, timeout)
 
+    def disk_bytes(self) -> int:
+        """On-disk footprint (head file + segments); for the resource
+        sampler's ``mirbft_resource_disk_bytes{store="wal"}`` series."""
+        return _tree_bytes(self.path)
+
     def close(self) -> None:
         try:
             self.sync()
@@ -356,23 +379,38 @@ _REQ_HEADER = struct.Struct("<BII")  # op, ack_len, data_len
 _OP_STORE = 1
 _OP_COMMIT = 2
 
+# Live compaction trigger for the request store's intent log: rewrite
+# the live set once the log passes the size floor AND is mostly dead
+# weight.  Without it the append-only log grows for the whole process
+# lifetime (compaction only ran at open), which the resource-leak soak
+# would rightly flag as disk growth.
+_COMPACT_MIN_BYTES = 4 * 1024 * 1024
+_COMPACT_DEAD_RATIO = 4
+
 
 class FileRequestStore:
     """store/get/commit/sync + uncommitted replay.
 
     An intent log: STORE records carry (ack, data); COMMIT records carry the
     ack only.  The live (uncommitted) set is the stores minus the commits;
-    compaction rewrites just the live set at open.
+    compaction rewrites just the live set — at open, and live whenever
+    the log exceeds ``compact_min_bytes`` while being mostly dead weight
+    (so long-running processes reclaim disk instead of growing forever).
     """
 
     def __init__(self, path: str):
         self.path = path
         os.makedirs(path, exist_ok=True)
         self._log_path = os.path.join(path, "requests.log")
+        # key -> (ack, data, record_bytes); record_bytes feeds the live
+        # size the compaction trigger compares the log against.
         self._index: dict[bytes, tuple] = {}  # guarded-by: _lock
         self._replay()
         self._compact()
         self._file = open(self._log_path, "ab")  # guarded-by: _lock
+        self.compact_min_bytes = _COMPACT_MIN_BYTES
+        self._log_size = self._file.tell()  # guarded-by: _lock
+        self._live_size = self._log_size  # guarded-by: _lock
         # Pre-fsync fault seam, mirroring FileWal.fault_hook.
         self.fault_hook = None
         # store/commit run from different pooled lanes (reference reqstore
@@ -413,33 +451,58 @@ class FileRequestStore:
             payload = data[pos + ack_len : pos + ack_len + data_len]
             pos += ack_len + data_len
             if op == _OP_STORE:
-                self._index[self._key(ack)] = (ack, payload)
+                self._index[self._key(ack)] = (
+                    ack,
+                    payload,
+                    _REQ_HEADER.size + ack_len + data_len,
+                )
             elif op == _OP_COMMIT:
                 self._index.pop(self._key(ack), None)
 
     def _compact(self) -> None:  # holds: _lock
         tmp = self._log_path + ".tmp"
         with open(tmp, "wb") as f:
-            for ack, data in self._index.values():
+            for ack, data, _size in self._index.values():
                 self._write_record(f, _OP_STORE, ack, data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._log_path)
         _fsync_dir(self.path)
 
+    def _maybe_compact_locked(self) -> None:  # holds: _lock
+        if self._log_size < self.compact_min_bytes:
+            return
+        if self._log_size <= _COMPACT_DEAD_RATIO * max(self._live_size, 1):
+            return
+        self._file.flush()
+        self._file.close()
+        self._compact()
+        self._file = open(self._log_path, "ab")
+        self._log_size = self._file.tell()
+        self._live_size = self._log_size
+        if hooks.enabled:
+            hooks.metrics.counter("mirbft_reqstore_compactions_total").inc()
+
     @staticmethod
-    def _write_record(f, op: int, ack: pb.RequestAck, data: bytes) -> None:
+    def _write_record(f, op: int, ack: pb.RequestAck, data: bytes) -> int:
         ack_bytes = pb.encode(ack)
         f.write(_REQ_HEADER.pack(op, len(ack_bytes), len(data)))
         f.write(ack_bytes)
         f.write(data)
+        return _REQ_HEADER.size + len(ack_bytes) + len(data)
 
     # -- runtime interface ---------------------------------------------------
 
     def store(self, ack: pb.RequestAck, data: bytes) -> None:
         with self._lock:
-            self._write_record(self._file, _OP_STORE, ack, data or b"")
-            self._index[self._key(ack)] = (ack, data or b"")
+            size = self._write_record(self._file, _OP_STORE, ack, data or b"")
+            self._log_size += size
+            key = self._key(ack)
+            old = self._index.pop(key, None)
+            if old is not None:
+                self._live_size -= old[2]
+            self._index[key] = (ack, data or b"", size)
+            self._live_size += size
             if hooks.enabled:
                 hooks.metrics.counter("mirbft_reqstore_appends_total").inc()
 
@@ -450,8 +513,13 @@ class FileRequestStore:
 
     def commit(self, ack: pb.RequestAck) -> None:
         with self._lock:
-            self._write_record(self._file, _OP_COMMIT, ack, b"")
-            self._index.pop(self._key(ack), None)
+            self._log_size += self._write_record(
+                self._file, _OP_COMMIT, ack, b""
+            )
+            old = self._index.pop(self._key(ack), None)
+            if old is not None:
+                self._live_size -= old[2]
+            self._maybe_compact_locked()
 
     def sync(self) -> None:
         with self._lock:
@@ -493,6 +561,11 @@ class FileRequestStore:
 
     def wait(self, token: int, timeout: float | None = None) -> bool:
         return self._group.wait(token, timeout)
+
+    def disk_bytes(self) -> int:
+        """On-disk footprint of the intent log; for the resource
+        sampler's ``mirbft_resource_disk_bytes{store="reqstore"}``."""
+        return _tree_bytes(self.path)
 
     def close(self) -> None:
         try:

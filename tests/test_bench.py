@@ -126,3 +126,103 @@ def test_live_payload_keys_present_in_main_schema():
     assert '"live_attack_clean_goodput_per_sec"' in src
     assert '"live_attack_clean_commit_p95_ms"' in src
     assert '"live_attack_goodput_ratio"' in src
+
+
+def test_bench_stream_journals_stages_as_they_finish(tmp_path):
+    """Every finished stage lands in the JSONL immediately — the
+    crash-proofing contract the SIGKILL test below relies on."""
+    path = str(tmp_path / "stream.jsonl")
+    stream = bench.BenchStream(path)
+    registry = Registry()
+    runner = bench.StageRunner(budget_s=60.0, registry=registry,
+                               stream=stream)
+    assert runner.run("fast", lambda: 41 + 1) == 42
+    # The stage line is durable before any later stage runs.
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["kind"] == "header"
+    assert lines[0]["schema"] == bench.BenchStream.SCHEMA
+    assert lines[-1] == {
+        "kind": "stage",
+        "stage": "fast",
+        "seconds": lines[-1]["seconds"],
+        "status": "ok",
+    }
+    try:
+        runner.run("boom", lambda: 1 / 0)
+    except ZeroDivisionError:
+        pass
+    stream.final({"metric": "m", "value": 1.0})
+    stream.close()
+    lines = [json.loads(l) for l in open(path)]
+    kinds = [l["kind"] for l in lines]
+    assert kinds == ["header", "stage", "stage", "final"]
+    boom = lines[2]
+    assert boom["stage"] == "boom" and boom["status"] == "error"
+    assert lines[3]["payload"]["value"] == 1.0
+
+
+def test_bench_stream_swallows_unwritable_path(tmp_path):
+    stream = bench.BenchStream(str(tmp_path / "no" / "such" / "dir.jsonl"))
+    stream.final({"x": 1})  # must not raise
+    stream.close()
+
+
+def test_stage_runner_warmup_excluded_from_timed_window():
+    registry = Registry()
+    runner = bench.StageRunner(budget_s=60.0, registry=registry)
+    result = runner.run(
+        "warm",
+        lambda: time.sleep(0.02) or "done",
+        warmup=lambda: time.sleep(0.15),
+    )
+    assert result == "done"
+    entry = runner.status["warm"]
+    assert entry["status"] == "ok"
+    assert entry["compile_s"] >= 0.15
+    timed = registry.gauge("mirbft_bench_stage_seconds", stage="warm").value
+    compile_s = registry.gauge(
+        "mirbft_bench_stage_compile_seconds", stage="warm"
+    ).value
+    assert compile_s >= 0.15
+    assert timed < compile_s  # compile cost stayed out of the fn timing
+
+
+def test_stream_survives_sigkill_mid_rung(tmp_path):
+    """Acceptance: SIGKILL while a rung is mid-flight leaves a valid
+    JSONL carrying every rung that already completed."""
+    import pathlib
+    import signal
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(bench.__file__).resolve().parent
+    path = str(tmp_path / "BENCH_stream.jsonl")
+    script = (
+        "import threading, bench\n"
+        "from mirbft_tpu.obsv.metrics import Registry\n"
+        f"stream = bench.BenchStream({path!r})\n"
+        "runner = bench.StageRunner(budget_s=600.0, registry=Registry(),\n"
+        "                           stream=stream)\n"
+        "runner.run('first', lambda: 'ok')\n"
+        "runner.run('second', lambda: 'ok')\n"
+        "print('RUNGS-DONE', flush=True)\n"
+        "runner.run('wedged', threading.Event().wait)\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        cwd=str(repo),
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "RUNGS-DONE"
+        proc.kill()  # SIGKILL: no atexit, no flush handlers
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    lines = [json.loads(l) for l in open(path)]  # every line parses
+    assert [l["kind"] for l in lines] == ["header", "stage", "stage"]
+    assert [l["stage"] for l in lines[1:]] == ["first", "second"]
+    assert all(l["status"] == "ok" for l in lines[1:])

@@ -245,6 +245,17 @@ def test_supervisor_boot_commit_kill_restart_teardown(tmp_path):
         assert sup.healthz(3) is None
         assert sorted(sup.alive_nodes()) == [0, 1, 2]
 
+        # The victim couldn't say why it died, but its black box can:
+        # autoflush left a committed flight segment, and the reap
+        # annotated it with the real cause.
+        import json
+
+        dumps = sup.flight_dumps()
+        assert 3 in dumps, dumps
+        victim = json.loads(open(dumps[3]).read())
+        assert victim["reason"] == "sigkill-reaped"
+        assert victim["entries"]
+
         # Restart from disk: the worker reboots via Node.restart, re-binds
         # its original transport port, and reports ready again.
         sup.restart(3)
@@ -265,6 +276,14 @@ def test_supervisor_boot_commit_kill_restart_teardown(tmp_path):
     finally:
         sup.teardown()
     assert sup.alive_nodes() == []
+
+    # Acceptance: the dumps on disk reconstruct a merged cross-node
+    # timeline after every process is gone.
+    from mirbft_tpu.obsv.recorder import postmortem
+
+    result = postmortem(str(tmp_path / "cluster"))
+    assert set(result["nodes"]) == {0, 1, 2, 3}
+    assert result["timeline"].splitlines()
 
 
 @pytest.mark.slow

@@ -334,6 +334,43 @@ def test_linter_confines_process_management_to_cluster(tmp_path):
     assert not any("W11" in line for line in lint.check_file(tests_ok))
 
 
+def test_linter_confines_resource_introspection_to_obsv(tmp_path):
+    """W14: resource/psutil process-introspection imports belong to the
+    obsv ResourceSampler; ad-hoc sampling elsewhere fragments the
+    cadence, the mirbft_resource_* gauge names, and the leak fits."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "runtime" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("import resource\nx = resource\n")
+    findings = lint.check_file(outside)
+    assert any("W14" in line for line in findings), findings
+
+    fromstyle = tmp_path / "mirbft_tpu" / "chaos" / "sneaky2.py"
+    fromstyle.parent.mkdir(parents=True)
+    fromstyle.write_text("from psutil import Process\nx = Process\n")
+    assert any("W14" in line for line in lint.check_file(fromstyle))
+
+    inside = tmp_path / "mirbft_tpu" / "obsv" / "resources.py"
+    inside.parent.mkdir(parents=True)
+    inside.write_text("import resource\nx = resource\n")
+    assert not any("W14" in line for line in lint.check_file(inside))
+
+    # The real sampler is the sanctioned importer.
+    assert not any(
+        "W14" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "obsv" / "resources.py"
+        )
+    )
+
+    # Tests, tools, and bench are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text("import resource\nx = resource\n")
+    assert not any("W14" in line for line in lint.check_file(tests_ok))
+
+
 def test_linter_confines_adversary_tooling_to_harness(tmp_path):
     """W13: core/ and runtime/ must not import mirbft_tpu.testengine or
     mirbft_tpu.chaos in any spelling — payload mutation and frame

@@ -1,0 +1,304 @@
+"""Flight recorder & resource telemetry: the black-box postmortem path.
+
+Covers the four load-bearing guarantees: the ring stays bounded no
+matter how much is recorded; an invariant failure leaves a parseable
+dump on disk; per-node dumps merge into one causal timeline via
+``obsv --postmortem``; and the least-squares leak verdict separates
+genuine growth from sawtooth/noise so the ``obsv --diff`` gate can fail
+PRs on it.  Ends with a seconds-scale smoke of the bench soak rung
+(real nodes, real sockets, on-disk stores)."""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from mirbft_tpu.obsv.recorder import (
+    SCHEMA,
+    SEGMENT_KEEP,
+    FlightRecorder,
+    annotate_dump,
+    dump_to_trace,
+    load_dumps,
+    postmortem,
+)
+from mirbft_tpu.obsv.resources import leak_verdict, sample_process
+
+
+# ----------------------------------------------------------------------
+# Ring buffer bounds
+# ----------------------------------------------------------------------
+
+
+def test_ring_stays_bounded_under_load():
+    rec = FlightRecorder("load", capacity=64, autoflush_every=0)
+    for i in range(10_000):
+        rec.record_event(f"ev{i % 7}", args={"i": i})
+    dump = rec.snapshot()
+    assert dump["schema"] == SCHEMA
+    assert len(dump["entries"]) == 64
+    assert dump["recorded"] == 10_000
+    assert dump["overwritten"] == 10_000 - 64
+    # Oldest-first, and the tail is the newest record.
+    ts = [e["ts_us"] for e in dump["entries"]]
+    assert ts == sorted(ts)
+    assert dump["entries"][-1]["args"]["i"] == 9_999
+
+
+def test_segments_rotate_in_place(tmp_path):
+    rec = FlightRecorder(
+        3, dump_dir=str(tmp_path), capacity=32, autoflush_every=8
+    )
+    for i in range(100):
+        rec.record_milestone("m", args={"i": i})
+    names = sorted(os.listdir(tmp_path))
+    assert len(names) <= SEGMENT_KEEP
+    assert all(n.endswith(".flight.json") for n in names)
+    # load_dumps keeps the newest committed segment for the node.
+    dumps = load_dumps(str(tmp_path))
+    assert set(dumps) == {3}
+    _path, dump = dumps[3]
+    assert dump["entries"][-1]["args"]["i"] == 95  # last autoflush at 96
+    # A torn/garbage file is skipped, not fatal.
+    (tmp_path / "nodeX-0.flight.json").write_text("{torn")
+    assert set(load_dumps(str(tmp_path))) == {3}
+
+
+def test_annotate_dump_adds_keys_atomically(tmp_path):
+    rec = FlightRecorder(0, dump_dir=str(tmp_path), autoflush_every=0)
+    rec.record_event("boot")
+    path = rec.flush("exit")
+    assert annotate_dump(path, reason="sigkill-reaped", rc=-9)
+    dump = json.loads(open(path).read())
+    assert dump["reason"] == "sigkill-reaped"
+    assert dump["rc"] == -9
+    assert dump["entries"]  # payload intact
+
+
+# ----------------------------------------------------------------------
+# Invariant failure -> dump on disk
+# ----------------------------------------------------------------------
+
+
+def test_chaos_invariant_failure_leaves_parseable_dump(monkeypatch, tmp_path):
+    from mirbft_tpu.chaos.runner import run_scenario
+    from mirbft_tpu.chaos.scenarios import smoke_matrix
+
+    monkeypatch.setenv("MIRBFT_CHAOS_DUMP_DIR", str(tmp_path))
+    # Starve the engine of steps: convergence is impossible, the
+    # no-convergence invariant fires, and the recorder must flush.
+    scenario = dataclasses.replace(smoke_matrix()[0], max_steps=3)
+    result = run_scenario(scenario, seed=7)
+    assert result.violation
+    assert result.dump
+    dump = json.loads(open(result.dump).read())
+    assert dump["schema"] == SCHEMA
+    assert dump["reason"] == "invariant-failure"
+    notes = [e for e in dump["entries"] if e["kind"] == "note"]
+    assert any(
+        e["name"] == "invariant.violation"
+        and e["args"]["scenario"] == scenario.name
+        and e["args"]["seed"] == 7
+        for e in notes
+    )
+    # The machine-readable scenario record carries the same path.
+    assert result.to_dict()["dump"] == result.dump
+
+
+# ----------------------------------------------------------------------
+# Postmortem merge round-trip
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture()
+def four_node_dumps(tmp_path):
+    for node in range(4):
+        rec = FlightRecorder(
+            node, dump_dir=str(tmp_path), autoflush_every=0
+        )
+        # Node n thinks every peer's clock reads n*1000ns behind.
+        rec.set_clock_offsets(
+            {peer: node * 1000 for peer in range(4) if peer != node}
+        )
+        for i in range(10):
+            rec.record_event("commit", args={"seq": i})
+        rec.record_milestone("checkpoint.stable", args={"seq": 9})
+        rec.flush("exit")
+    return str(tmp_path)
+
+
+def test_postmortem_merges_four_nodes(four_node_dumps, tmp_path):
+    out = str(tmp_path / "merged.json")
+    result = postmortem(four_node_dumps, out_path=out)
+    assert result["nodes"] == [0, 1, 2, 3]
+    merged = json.loads(open(out).read())
+    instants = [
+        ev
+        for ev in merged["traceEvents"]
+        if ev.get("ph") == "i" and ev.get("cat", "").startswith("flight.")
+    ]
+    # 4 nodes x (10 events + 1 milestone), all preserved by the merge.
+    assert len(instants) == 44
+    assert {ev["pid"] for ev in instants} == {0, 1, 2, 3}
+    # The rendered timeline ends at the latest instant.
+    assert result["timeline"].splitlines()
+    assert "checkpoint.stable" in result["timeline"]
+
+
+def test_postmortem_cli_round_trip(four_node_dumps, tmp_path, capsys):
+    from mirbft_tpu.obsv.__main__ import main as obsv_main
+
+    out = str(tmp_path / "cli-merged.json")
+    rc = obsv_main(["--postmortem", four_node_dumps, "--out", out])
+    assert rc == 0
+    assert json.loads(open(out).read())["traceEvents"]
+    text = capsys.readouterr().out
+    assert "4 node dump(s)" in text
+
+
+def test_postmortem_empty_dir_is_distinct_error(tmp_path, capsys):
+    from mirbft_tpu.obsv.__main__ import main as obsv_main
+
+    assert obsv_main(["--postmortem", str(tmp_path)]) == 2
+
+
+def test_dump_to_trace_carries_clock_sync():
+    rec = FlightRecorder(2)
+    rec.set_clock_offsets({0: -500, 1: 250})
+    rec.record_event("x")
+    trace = dump_to_trace(rec.snapshot())
+    sync = [
+        ev for ev in trace["traceEvents"] if ev["name"] == "clock_sync"
+    ]
+    assert sync and sync[0]["args"]["offsets_ns"] == {"0": -500, "1": 250}
+
+
+# ----------------------------------------------------------------------
+# Leak verdicts
+# ----------------------------------------------------------------------
+
+
+def test_leak_verdict_growing_on_linear_series():
+    series = [(t * 1.0, 1_000_000 + t * 5_000) for t in range(60)]
+    v = leak_verdict(series)
+    assert v["verdict"] == "growing"
+    assert v["confidence"] > 0.9
+    assert v["rel_pct_per_min"] > 5.0
+    assert v["n"] == 60
+
+
+def test_leak_verdict_flat_on_constant_and_noisy_series():
+    flat = leak_verdict([(t * 1.0, 1_000_000) for t in range(60)])
+    assert flat["verdict"] == "flat"
+    assert flat["confidence"] == 1.0
+    # Zero-mean noise: slope ~0, stays flat.
+    noisy = leak_verdict(
+        [(t * 1.0, 1_000_000 + (7 * t % 13 - 6) * 1_000) for t in range(60)]
+    )
+    assert noisy["verdict"] == "flat"
+
+
+def test_leak_verdict_sawtooth_is_confident_flat():
+    # Disk between compactions: steep nominal slope the fit can't
+    # explain (r^2 ~ 0) must read as flat with high confidence.
+    series = [(t * 1.0, 1_000_000 + (t % 10) * 400_000) for t in range(60)]
+    v = leak_verdict(series)
+    assert v["verdict"] == "flat"
+    assert v["r2"] < 0.5
+    assert v["confidence"] > 0.5
+
+
+def test_leak_verdict_short_series_stays_flat():
+    v = leak_verdict([(t * 1.0, 100 + t * 50) for t in range(5)])
+    assert v["verdict"] == "flat"  # n < min_samples, however steep
+
+
+def test_sample_process_reports_real_resources(tmp_path):
+    (tmp_path / "blob").write_bytes(b"x" * 4096)
+    sample = sample_process(dirs={"store": str(tmp_path)})
+    assert sample["rss_bytes"] > 1_000_000
+    assert sample["open_fds"] > 0
+    assert sample["threads"] >= 1
+    assert sample["disk.store"] >= 4096
+
+
+# ----------------------------------------------------------------------
+# Diff gate consumes soak verdicts
+# ----------------------------------------------------------------------
+
+
+def _bench_artifact(leak):
+    return {
+        "schema": "mirbft-bench/1",
+        "stages": {},
+        "soak": {"seconds": 30.0, "commits": 100, "leak": leak},
+    }
+
+
+def test_diff_leak_gate_fails_on_growing(tmp_path):
+    from mirbft_tpu.obsv.diff import diff_files, render_report
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_artifact({})))
+    b.write_text(
+        json.dumps(
+            _bench_artifact(
+                {
+                    "rss_bytes": {
+                        "verdict": "growing",
+                        "confidence": 0.97,
+                        "rel_pct_per_min": 12.0,
+                        "first": 1e6,
+                        "last": 2e6,
+                    },
+                    "open_fds": {"verdict": "flat", "confidence": 1.0},
+                }
+            )
+        )
+    )
+    report = diff_files(str(a), str(b))
+    assert not report["ok"]
+    assert [f["series"] for f in report["leak_failures"]] == [
+        "soak.rss_bytes"
+    ]
+    assert "LEAK" in render_report(report)
+
+    # CLI contract: leak regression exits nonzero like a p95 regression.
+    from mirbft_tpu.obsv.__main__ import main as obsv_main
+
+    assert obsv_main(["--diff", str(a), str(b)]) == 1
+    # Flat-only verdicts pass.
+    b.write_text(
+        json.dumps(
+            _bench_artifact({"rss_bytes": {"verdict": "flat",
+                                           "confidence": 0.9}})
+        )
+    )
+    assert obsv_main(["--diff", str(a), str(b)]) == 0
+
+
+# ----------------------------------------------------------------------
+# Soak smoke (tier-1, seconds-scale)
+# ----------------------------------------------------------------------
+
+
+def test_soak_smoke_commits_and_emits_verdicts():
+    import bench
+
+    out = bench.soak_run(duration_s=6.0, sample_interval_s=0.25)
+    assert out["commits"] > 0
+    assert out["samples"] >= 8
+    assert set(out["leak"]) == {
+        "rss_bytes",
+        "open_fds",
+        "threads",
+        "disk.reqstore",
+        "disk.wal",
+    }
+    for verdict in out["leak"].values():
+        assert verdict["verdict"] in ("flat", "growing")
+    # fd/thread leaks have no warm-up excuse even at smoke scale.
+    assert out["leak"]["open_fds"]["verdict"] == "flat"
+    assert out["leak"]["threads"]["verdict"] == "flat"

@@ -1,6 +1,6 @@
-"""General defect classes W1..W13 (the original tools/lint.py checks as
+"""General defect classes W1..W14 (the original tools/lint.py checks as
 Rule objects, message-compatible, plus the seeded-randomness ban and the
-adversary-tooling confinement).
+adversary-tooling and resource-introspection confinements).
 
 The catalog (rationale per rule lives in docs/ANALYSIS.md):
 
@@ -26,6 +26,10 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   ``core/`` or ``runtime/``.  The protocol must not depend on its own
   attack harness; the flow is strictly one-way (the harness wraps the
   protocol, never the reverse).
+- W14 ``resource``/``psutil`` outside ``mirbft_tpu/obsv/resources.py``
+  — process introspection (RSS, fd counts, rusage) goes through the
+  obsv resource sampler so the sampling cadence, gauge names, and leak
+  fits stay in one place.
 """
 
 from __future__ import annotations
@@ -179,6 +183,22 @@ PROTOCOL_TREES = ("mirbft_tpu/core/", "mirbft_tpu/runtime/")
 def in_adversary_ban_scope(posix: str) -> bool:
     """True for files inside the protocol trees W13 protects."""
     return any(tree in posix for tree in PROTOCOL_TREES)
+
+
+# The only module allowed to introspect process resources (RSS, fd
+# counts, rusage): the obsv resource sampler owns the cadence, the gauge
+# names, and the leak fit — scattered ad-hoc sampling would fragment all
+# three.
+RESOURCE_ALLOWED_FILE = "mirbft_tpu/obsv/resources.py"
+
+# Modules whose import anywhere else in mirbft_tpu/ trips W14.
+RESOURCE_MODULES = ("resource", "psutil")
+
+
+def in_resource_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W14 bans process-introspection
+    imports."""
+    return "mirbft_tpu/" in posix and RESOURCE_ALLOWED_FILE not in posix
 
 
 def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
@@ -420,6 +440,31 @@ def _check_w11(ctx: FileContext):
                 node.lineno,
                 "subprocess/multiprocessing outside cluster/ (process "
                 "lifecycle goes through the cluster supervisor)",
+            )
+
+
+def _check_w14(ctx: FileContext):
+    prefixes = tuple(m + "." for m in RESOURCE_MODULES)
+    for node in ast.walk(ctx.tree):
+        hit = False
+        if isinstance(node, ast.Import):
+            hit = any(
+                alias.name in RESOURCE_MODULES
+                or alias.name.startswith(prefixes)
+                for alias in node.names
+            )
+        elif isinstance(node, ast.ImportFrom):
+            hit = node.module is not None and (
+                node.module in RESOURCE_MODULES
+                or node.module.startswith(prefixes)
+            )
+        if hit:
+            yield Finding(
+                "W14",
+                ctx.path,
+                node.lineno,
+                "resource/psutil outside obsv/resources.py (process "
+                "introspection goes through the obsv resource sampler)",
             )
 
 
@@ -701,5 +746,18 @@ register(
         ),
         check=_as_list(check_w12),
         scope=in_package_scope,
+    )
+)
+register(
+    Rule(
+        id="W14",
+        title="resource introspection outside obsv/resources.py",
+        doc=(
+            "resource/psutil process-introspection imports are confined "
+            "to the obsv resource sampler so cadence, gauge names, and "
+            "leak fits stay in one place."
+        ),
+        check=_as_list(_check_w14),
+        scope=in_resource_ban_scope,
     )
 )
