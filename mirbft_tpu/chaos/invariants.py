@@ -105,6 +105,33 @@ def check_full_convergence(rec) -> None:
         )
 
 
+def check_no_vector_divergence(rec) -> None:
+    """The ``_FastAcks`` vector ack path provably agrees with the scalar
+    reference path on every node: the shadow oracle (obsv.shadow)
+    re-derives weak/strong/available membership and tick classes from the
+    mirror's masks and diffs them against the live objects.  Vacuous on
+    nodes that never built a mirror (the scalar path IS the reference).
+
+    Unlike the other invariants this one reads protocol-internal state,
+    not harness evidence — it is exactly the determinism precondition Mir
+    assumes of its replicas, checked from the inside."""
+    from ..obsv import shadow
+
+    for node in range(rec.node_count):
+        tracker = rec.machines[node].client_tracker
+        if getattr(tracker, "_fast", None) is None:
+            continue
+        divs = shadow.audit_tracker(tracker)
+        if divs:
+            first = divs[0]
+            raise InvariantViolation(
+                f"node {node}: vector ack path diverged from the scalar "
+                f"reference in {len(divs)} place(s); first: "
+                f"{first['component']} at client {first['client_id']} "
+                f"req_no {first['req_no']} ({first['detail']})"
+            )
+
+
 def check_commit_resumption(
     commit_times_ms: list, heal_ms: int, bound_ms: int
 ) -> None:

@@ -1476,6 +1476,26 @@ def run_live_scenario(
             evidence = _LiveEvidence(cluster.replicas)
             check_no_fork(evidence)
             check_durable_prefix(evidence, cluster.snapshots)
+            # Live form of check_no_vector_divergence: the oracle must run
+            # on each serializer thread (the tracker is thread-confined),
+            # so ask every live node to audit itself.
+            divergences = 0
+            for replica in cluster.alive_replicas():
+                try:
+                    divs = replica.node.audit_divergence(timeout=5.0)
+                except Exception:
+                    divs = None  # stopping/stopped replica: nothing to audit
+                if divs:
+                    divergences += len(divs)
+                    first = divs[0]
+                    raise InvariantViolation(
+                        f"node {replica.node_id}: vector ack path diverged "
+                        f"from the scalar reference in {len(divs)} place(s); "
+                        f"first: {first['component']} at client "
+                        f"{first['client_id']} req_no {first['req_no']} "
+                        f"({first['detail']})"
+                    )
+            result.counters["divergences"] = divergences
             if scenario.expect_epoch_change:
                 delta = _epoch_active_total(registry) - epoch_active_before
                 result.counters["epoch_active_events"] = delta

@@ -28,6 +28,7 @@ from .invariants import (
     check_full_convergence,
     check_no_fork,
     check_no_fork_under_equivocation,
+    check_no_vector_divergence,
 )
 from .scenarios import Scenario, matrix
 
@@ -285,6 +286,7 @@ def run_scenario(
         check_no_fork(rec)
         check_durable_prefix(rec, snapshots)
         check_full_convergence(rec)
+        check_no_vector_divergence(rec)
         ends = scenario.disruption_ends()
         # Recovery time flows through the metrics registry so the same
         # number shows up in chaos reports, status snapshots, and tests:

@@ -1457,8 +1457,13 @@ class ClientTracker:
             if fast is None:
                 fast = self._fast = _FastAcks(self)
             self._step_ack_vector(source, msgs, fast)
-            return
-        self._step_ack_loop(source, msgs)
+        else:
+            self._step_ack_loop(source, msgs)
+        # Divergence oracle (obsv.shadow): every Nth frame replays the
+        # scalar rules against the mirror for the slots this frame touched.
+        sh = hooks.shadow
+        if sh is not None:
+            sh.on_frame(self, msgs)
 
     def _step_ack_vector(
         self, source: int, msgs: list, fast: "_FastAcks"

@@ -13,8 +13,11 @@ Tool modes (mutually exclusive with the run):
   Perfetto-loadable file with per-node process lanes (obsv/merge.py).
 - ``--diff A B [--threshold PCT]`` — compare two trace/bench artifacts;
   prints a human summary plus one machine-readable JSON line, exits
-  nonzero on a >= threshold regression or a ``growing`` resource-leak
-  verdict in B (obsv/diff.py).
+  nonzero on a >= threshold regression, a ``growing`` resource-leak
+  verdict in B, a device retrace-budget breach in B, or any recorded
+  scalar/vector divergence in B (obsv/diff.py).  Either path may be a
+  ``BENCH_stream.jsonl`` journal — torn or killed runs are recovered
+  from their stage lines automatically.
 - ``--postmortem DIR [--out PATH]`` — merge every node's newest flight
   recorder dump under DIR into one clock-aligned causal timeline ending
   at the failure (obsv/recorder.py); ``--out`` also writes the merged

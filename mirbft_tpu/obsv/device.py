@@ -1,0 +1,274 @@
+"""Device-plane telemetry: kernel timings, retrace detection, transfers.
+
+The host-protocol obsv stack (metrics/trace/recorder) is blind to the
+device plane — the ``ops/`` kernels, ``parallel/sharding.py`` and the
+testengine crypto planes run jit-compiled programs whose compile storms,
+silent retraces and transfer volumes never reach the catalog.  This
+module closes that gap with a single decorator:
+
+    from ..obsv import device as _device
+
+    @_device.instrument("sha256_digest")
+    def sha256_digest_words(blocks, n_blocks): ...
+
+Per call (only while capture is active — see below) the wrapper
+
+- observes wall time in ``mirbft_device_kernel_seconds{kernel}``;
+- computes an *abstract-shape signature* of the arguments (shape+dtype
+  for arrays, bucketed length for sequences, value for scalars — the
+  same abstraction jit uses to decide whether to retrace) and bumps
+  ``mirbft_device_retraces_total{fn}`` whenever a new signature shows
+  up.  A per-function retrace budget turns unbounded-shape
+  recompilation — the classic silent TPU perf killer — into a gate
+  failure (``report()["retrace_breaches"]``, enforced by ``obsv
+  --diff``);
+- estimates host->device / device->host traffic from argument/result
+  nbytes into ``mirbft_device_transfer_bytes_total{direction}``.
+
+``sync=True`` (default) blocks on the result inside the timed window so
+the histogram sees real device time; entry points whose callers measure
+async dispatch themselves (the chain-checksum microbenches) pass
+``sync=False`` so instrumentation never perturbs their protocol.
+
+Gating: the wrapper is active when either ``start_capture(registry)``
+installed a capture registry (bench runs) or ``hooks.enabled`` is on
+(tests, chaos).  Off, the cost is one module-attribute load and a
+branch — same <2% discipline as every other obsv hook.
+
+``memory_sample()`` reports live-buffer and HBM gauges; the
+ResourceSampler calls it on its existing cadence, and it never imports
+jax itself (``sys.modules`` guard) so pure-host runs stay jax-free.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+from .metrics import CardinalityError
+
+#: New distinct abstract signatures tolerated per function before the
+#: function lands in ``report()["retrace_breaches"]``.  Steady-state
+#: callers go through ops.batching's power-of-two buckets, so a handful
+#: of signatures is normal; growth past the budget means some caller is
+#: feeding unbucketed shapes and recompiling per call.
+DEFAULT_RETRACE_BUDGET = 8
+
+_capture_registry = None  # Registry while start_capture() is active
+_retrace_budget = DEFAULT_RETRACE_BUDGET
+_signatures: dict = {}  # fn name -> set of abstract signatures seen
+_retraces: dict = {}  # fn name -> count of new-signature events
+_breaches: list = []  # fn names that exceeded the budget (insertion order)
+
+
+def reset():
+    """Forget all signatures, counts and breaches (new bench run)."""
+    _signatures.clear()
+    _retraces.clear()
+    del _breaches[:]
+
+
+def start_capture(registry, retrace_budget=None):
+    """Route device telemetry into ``registry`` independently of the
+    hooks switchboard (bench stages toggle hooks themselves; the device
+    capture must span the whole run)."""
+    global _capture_registry, _retrace_budget
+    _capture_registry = registry
+    if retrace_budget is not None:
+        _retrace_budget = retrace_budget
+
+
+def stop_capture():
+    global _capture_registry, _retrace_budget
+    _capture_registry = None
+    _retrace_budget = DEFAULT_RETRACE_BUDGET
+
+
+def _registry():
+    if _capture_registry is not None:
+        return _capture_registry
+    from . import hooks
+
+    if hooks.enabled:
+        return hooks.metrics
+    return None
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _abstract(value):
+    """Abstract signature of one argument — the granularity at which
+    jit retraces.  Sequences are bucketed to the next power of two so a
+    list-taking entry point (verify_batch, aggregate_signatures) is not
+    charged a retrace per distinct length (ops.batching pads to pow2
+    buckets before tracing)."""
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if value is None or isinstance(value, (bool, int, float, str, bytes)):
+        return ("static", value)
+    if isinstance(value, (list, tuple)):
+        return ("seq", type(value).__name__, _next_pow2(len(value)))
+    return ("obj", type(value).__name__)
+
+
+def _signature(args, kwargs):
+    sig = tuple(_abstract(a) for a in args)
+    if kwargs:
+        sig += tuple((k, _abstract(v)) for k, v in sorted(kwargs.items()))
+    return sig
+
+
+def _nbytes(value) -> int:
+    n = getattr(value, "nbytes", None)
+    if isinstance(n, int):
+        return n
+    if isinstance(value, (list, tuple)):
+        return sum(_nbytes(v) for v in value)
+    return 0
+
+
+def _note_signature(fn_name, sig, registry):
+    seen = _signatures.get(fn_name)
+    if seen is None:
+        seen = _signatures[fn_name] = set()
+    if sig in seen:
+        return
+    seen.add(sig)
+    _retraces[fn_name] = _retraces.get(fn_name, 0) + 1
+    if _retraces[fn_name] > _retrace_budget and fn_name not in _breaches:
+        _breaches.append(fn_name)
+    try:
+        registry.counter("mirbft_device_retraces_total", fn=fn_name).inc()
+    except CardinalityError:
+        pass  # over budget: the dict above still has the truth
+
+
+def instrument(kernel, *, sync=True, fn_name=None):
+    """Decorator wrapping one device-plane entry point.
+
+    ``kernel`` labels the timing histogram; ``fn_name`` labels the
+    retrace counter (defaults to the wrapped function's ``__name__`` —
+    pass it explicitly for closures that all compile as ``run``).
+    ``sync=False`` skips the block-until-ready so entry points with
+    their own async measurement protocol stay undisturbed.
+    """
+
+    def deco(fn):
+        label = fn_name or getattr(fn, "__name__", kernel)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            registry = _registry()
+            if registry is None:
+                return fn(*args, **kwargs)
+            _note_signature(label, _signature(args, kwargs), registry)
+            start = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if sync:
+                try:
+                    import jax
+
+                    out = jax.block_until_ready(out)
+                except Exception:
+                    pass  # tracers / non-jax results: timing stays dispatch-only
+            elapsed = time.perf_counter() - start
+            try:
+                registry.histogram(
+                    "mirbft_device_kernel_seconds", kernel=kernel
+                ).observe(elapsed)
+                h2d = sum(_nbytes(a) for a in args)
+                if h2d:
+                    registry.counter(
+                        "mirbft_device_transfer_bytes_total", direction="h2d"
+                    ).inc(h2d)
+                d2h = _nbytes(out)
+                if d2h:
+                    registry.counter(
+                        "mirbft_device_transfer_bytes_total", direction="d2h"
+                    ).inc(d2h)
+            except CardinalityError:
+                pass
+            return out
+
+        return wrapper
+
+    return deco
+
+
+def memory_sample():
+    """Live-buffer and HBM usage, or None when jax was never imported.
+
+    Returns ``{"live_buffers": int, "live_buffer_bytes": int,
+    "hbm_bytes": int}``.  ``hbm_bytes`` is 0 on backends without
+    ``memory_stats`` (CPU).  Never imports jax itself: if the process
+    has not paid for jax, neither does its resource sampling.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        arrays = jax.live_arrays()
+        live = len(arrays)
+        live_bytes = 0
+        for a in arrays:
+            n = getattr(a, "nbytes", 0)
+            if isinstance(n, int):
+                live_bytes += n
+        hbm = 0
+        stats = getattr(jax.devices()[0], "memory_stats", None)
+        if callable(stats):
+            raw = stats()
+            if raw:
+                hbm = int(raw.get("bytes_in_use", 0))
+        return {
+            "live_buffers": live,
+            "live_buffer_bytes": live_bytes,
+            "hbm_bytes": hbm,
+        }
+    except Exception:
+        return None
+
+
+def report(registry):
+    """Summarize the capture for the bench payload's ``device`` section.
+
+    Pulls kernel timings from the registry snapshot and retrace truth
+    from the module dicts (the dicts survive CardinalityError drops)."""
+    snap = registry.snapshot()
+    kernels = {}
+    entry = snap.get("mirbft_device_kernel_seconds")
+    if entry:
+        for series in entry.get("series", ()):
+            name = series["labels"].get("kernel", "?")
+            count = series.get("count", 0)
+            total = series.get("sum", 0.0)
+            kernels[name] = {
+                "count": count,
+                "total_s": total,
+                "mean_ms": (total / count * 1e3) if count else 0.0,
+            }
+    transfers = {}
+    entry = snap.get("mirbft_device_transfer_bytes_total")
+    if entry:
+        for series in entry.get("series", ()):
+            transfers[series["labels"].get("direction", "?")] = series["value"]
+    divergence = 0
+    entry = snap.get("mirbft_divergence_total")
+    if entry:
+        divergence = sum(s["value"] for s in entry.get("series", ()))
+    return {
+        "kernel_seconds": kernels,
+        "retraces": dict(_retraces),
+        "retrace_budget": _retrace_budget,
+        "retrace_breaches": list(_breaches),
+        "transfer_bytes": transfers,
+        "divergence_total": divergence,
+    }

@@ -32,6 +32,19 @@ JSON with a ``soak.leak`` mapping, or a standalone ``mirbft-soak/…``
 artifact), any metric whose verdict is ``growing`` is a
 ``leak_failures`` entry and fails the diff exactly like a p95
 regression — RSS or on-disk growth gates PRs, not just speed.
+
+Device-plane gating: a bench ``device`` section contributes
+``device.<fn>.retraces`` (gated: retrace *growth* between rungs is a
+regression) and kernel timing series, and three absolute failures —
+a retrace-budget breach in B, any shadow-oracle divergence recorded in
+B, or a nonzero ``soak.divergence`` count — land in
+``device_failures`` and fail the diff regardless of A.
+
+Recovery: ``load_artifact`` accepts either a bench summary JSON or a
+``BENCH_stream.jsonl`` journal (auto-detected) — when the summary is
+missing or torn (rc=124 runs), the journal's ``final`` line or, failing
+that, its stage lines reconstruct the artifact, so the perf trajectory
+is never empty.
 """
 
 from __future__ import annotations
@@ -43,7 +56,9 @@ from .timeline import TimelineProfiler
 DEFAULT_THRESHOLD_PCT = 10.0
 
 _HIGHER_BETTER = ("per_sec", "rate", "count", "events", "reqs", "verified")
-_LOWER_BETTER = ("p50", "p95", "p99", "_ms", "ms_", "seconds", "wall", "sim_ms")
+_LOWER_BETTER = (
+    "p50", "p95", "p99", "_ms", "ms_", "seconds", "wall", "sim_ms", "retrace",
+)
 
 
 def direction(name):
@@ -108,6 +123,23 @@ def extract_series(artifact):
     loadgen_doc = artifact.get("loadgen")
     if isinstance(loadgen_doc, dict):
         series.update(_loadgen_series(loadgen_doc, prefix="loadgen."))
+    device = artifact.get("device")
+    if isinstance(device, dict):
+        for fn, n in sorted((device.get("retraces") or {}).items()):
+            if isinstance(n, (int, float)) and not isinstance(n, bool):
+                series[f"device.{fn}.retraces"] = float(n)
+        for kernel, info in sorted((device.get("kernel_seconds") or {}).items()):
+            mean = (info or {}).get("mean_ms")
+            if isinstance(mean, (int, float)) and not isinstance(mean, bool):
+                series[f"device.{kernel}.mean_ms"] = float(mean)
+            calls = (info or {}).get("count")
+            if isinstance(calls, (int, float)) and not isinstance(calls, bool):
+                # "calls" deliberately matches no direction token: launch
+                # counts vary run-to-run and must not gate.
+                series[f"device.{kernel}.calls"] = float(calls)
+        for dirn, n in sorted((device.get("transfer_bytes") or {}).items()):
+            if isinstance(n, (int, float)) and not isinstance(n, bool):
+                series[f"device.transfer.{dirn}"] = float(n)
     for metric, verdict in sorted(extract_leaks(artifact).items()):
         for key in ("first", "last", "rel_pct_per_min"):
             value = verdict.get(key)
@@ -185,16 +217,82 @@ def diff_series(a, b, threshold_pct=DEFAULT_THRESHOLD_PCT):
     }
 
 
+def recover_stream(path):
+    """Reconstruct a bench artifact from a ``BENCH_stream.jsonl`` journal.
+
+    The ``final`` line, when present, IS the artifact.  Otherwise (the
+    run was killed mid-flight) the stage lines rebuild a reduced
+    artifact — per-stage seconds/status/compile_s — under the schema
+    ``mirbft-bench-recovered/1`` with ``recovered: true`` so consumers
+    can tell a rescued rung from a clean one.  Torn trailing lines
+    (SIGKILL mid-write) are skipped, not fatal.
+    """
+    header = None
+    final = None
+    stages = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a killed run
+            if not isinstance(rec, dict):
+                continue
+            kind = rec.get("kind")
+            if kind == "header":
+                header = rec
+            elif kind == "stage":
+                name = rec.get("stage", "?")
+                stages[name] = {
+                    k: v
+                    for k, v in rec.items()
+                    if k not in ("kind", "stage", "schema")
+                }
+            elif kind == "final" and isinstance(rec.get("payload"), dict):
+                final = rec["payload"]
+    if final is not None:
+        return final
+    doc = {
+        "schema": "mirbft-bench-recovered/1",
+        "recovered": True,
+        "stages": stages,
+    }
+    if header is not None:
+        doc["pid"] = header.get("pid")
+    return doc
+
+
+def load_artifact(path):
+    """Load one artifact: a JSON document, or a bench-stream journal
+    (``.jsonl`` — or any file whose body is line-JSON) recovered via
+    :func:`recover_stream`."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return recover_stream(path)
+    if isinstance(doc, dict) and doc.get("kind") == "header" and str(
+        doc.get("schema", "")
+    ).startswith("mirbft-bench-stream"):
+        # A one-line journal (header only, run died before any stage).
+        return recover_stream(path)
+    return doc
+
+
 def diff_files(path_a, path_b, threshold_pct=DEFAULT_THRESHOLD_PCT):
-    """Load, extract, and diff two artifact files."""
-    with open(path_a, "r", encoding="utf-8") as f:
-        a = json.load(f)
-    with open(path_b, "r", encoding="utf-8") as f:
-        b = json.load(f)
+    """Load, extract, and diff two artifact files (summary JSON or
+    bench-stream journals — see :func:`load_artifact`)."""
+    a = load_artifact(path_a)
+    b = load_artifact(path_b)
     report = diff_series(
         extract_series(a), extract_series(b), threshold_pct=threshold_pct
     )
     apply_leak_gate(report, b)
+    apply_device_gate(report, b)
     report["a"] = str(path_a)
     report["b"] = str(path_b)
     return report
@@ -225,6 +323,51 @@ def apply_leak_gate(report, artifact_b):
     return report
 
 
+def apply_device_gate(report, artifact_b):
+    """Fold B's device-plane verdicts into a diff report (in place).
+
+    Absolute failures, like leaks: a retrace-budget breach or any
+    recorded scalar/vector divergence in the *new* artifact fails the
+    gate regardless of A."""
+    failures = []
+    device = artifact_b.get("device")
+    if isinstance(device, dict):
+        budget = device.get("retrace_budget")
+        retraces = device.get("retraces") or {}
+        for fn in device.get("retrace_breaches") or ():
+            failures.append(
+                {
+                    "series": f"device.{fn}.retraces",
+                    "kind": "retrace_budget",
+                    "count": retraces.get(fn),
+                    "budget": budget,
+                }
+            )
+        total = device.get("divergence_total")
+        if isinstance(total, (int, float)) and total > 0:
+            failures.append(
+                {
+                    "series": "device.divergence_total",
+                    "kind": "divergence",
+                    "count": total,
+                }
+            )
+    soak = artifact_b.get("soak")
+    if isinstance(soak, dict):
+        div = soak.get("divergence")
+        if isinstance(div, (int, float)) and div > 0:
+            failures.append(
+                {
+                    "series": "soak.divergence",
+                    "kind": "divergence",
+                    "count": div,
+                }
+            )
+    report["device_failures"] = failures
+    report["ok"] = report["ok"] and not failures
+    return report
+
+
 def render_report(report):
     """Human-readable summary lines for the CLI."""
     lines = [
@@ -247,6 +390,17 @@ def render_report(report):
             f"{entry['last']:g} ({entry['rel_pct_per_min']:+.1f}%/min, "
             f"confidence {entry['confidence']:.2f})"
         )
+    for entry in report.get("device_failures", ()):
+        if entry["kind"] == "retrace_budget":
+            lines.append(
+                f"  DEVICE    {entry['series']}: {entry['count']} retraces "
+                f"(budget {entry['budget']})"
+            )
+        else:
+            lines.append(
+                f"  DEVICE    {entry['series']}: {entry['count']} "
+                "scalar/vector divergence(s)"
+            )
     lines.append(
         f"  unchanged: {len(report['unchanged'])}  "
         f"informational: {len(report['informational'])}  "
@@ -257,5 +411,7 @@ def render_report(report):
         verdict = "REGRESSION"
     elif report.get("leak_failures"):
         verdict = "LEAK"
+    elif report.get("device_failures"):
+        verdict = "DEVICE"
     lines.append("VERDICT: " + verdict)
     return "\n".join(lines)

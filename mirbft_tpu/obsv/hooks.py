@@ -34,6 +34,7 @@ tracer = None  # Tracer when tracing was requested, else None
 recorder = None  # FlightRecorder when wired, else None
 sim_now = None  # simulated ms (testengine runs), None under the runtime
 sample_rate = None  # span sampling rate in (0, 1], None = keep everything
+shadow = None  # ShadowSampler when the divergence oracle is wired, else None
 
 # (node, epoch) -> perf_counter at "epoch.changing"; consumed by
 # "epoch.active" to observe mirbft_epoch_change_seconds.  Cleared on
@@ -47,6 +48,7 @@ def enable(
     sample_rate=None,
     sample_seed=0,
     recorder=None,
+    shadow=None,
 ):
     """Turn observability on.  Returns ``(metrics, tracer)``.
 
@@ -58,6 +60,9 @@ def enable(
     touches milestones or flow events.  ``recorder`` optionally wires a
     :class:`~mirbft_tpu.obsv.recorder.FlightRecorder` so milestones and
     StateEvents also land in the black-box ring (see obsv/recorder.py).
+    ``shadow`` optionally wires a
+    :class:`~mirbft_tpu.obsv.shadow.ShadowSampler` — the scalar/vector
+    divergence oracle the client tracker's ack frames feed.
     """
     global enabled, metrics, tracer, sim_now
     from .metrics import Registry
@@ -71,6 +76,7 @@ def enable(
     sim_now = None
     globals()["sample_rate"] = sample_rate
     globals()["recorder"] = recorder
+    globals()["shadow"] = shadow
     _epoch_change_started.clear()
     enabled = True
     return metrics, tracer
@@ -78,13 +84,14 @@ def enable(
 
 def disable():
     """Restore the no-op state (instrumentation sites become one branch)."""
-    global enabled, metrics, tracer, recorder, sim_now, sample_rate
+    global enabled, metrics, tracer, recorder, sim_now, sample_rate, shadow
     enabled = False
     metrics = None
     tracer = None
     recorder = None
     sim_now = None
     sample_rate = None
+    shadow = None
     _epoch_change_started.clear()
 
 

@@ -48,6 +48,13 @@ CATALOG = {
     "mirbft_crypto_flush_seconds": "Blocking wall time of one crypto-plane flush/launch/readback.",
     "mirbft_crypto_flush_total": "Crypto-plane flush/launch/readback operations, by plane and path.",
     "mirbft_crypto_items_total": "Digests or signature verdicts produced, by plane and path (device/host/readback/rescued/inline/batch).",
+    "mirbft_device_hbm_bytes": "Accelerator bytes_in_use reported by the backend's memory_stats (0 on backends without it), sampled by obsv.resources.",
+    "mirbft_device_kernel_seconds": "Wall time per instrumented device-plane kernel call (blocking until ready unless the entry point opts out).",
+    "mirbft_device_live_buffers": "Live jax arrays held by the process, sampled by obsv.resources.",
+    "mirbft_device_live_buffer_bytes": "Total bytes of live jax arrays, sampled by obsv.resources.",
+    "mirbft_device_retraces_total": "New abstract-shape signatures seen per device-plane function (each is one jit retrace; growth past the budget fails obsv --diff).",
+    "mirbft_device_transfer_bytes_total": "Estimated host<->device traffic of instrumented kernel calls, by direction (h2d from argument nbytes, d2h from result nbytes).",
+    "mirbft_divergence_total": "Scalar/vector divergences found by the shadow oracle, by component (committed/weak/strong/available/membership/tick_class).",
     "mirbft_engine_events_total": "Events processed by a testengine Recorder run.",
     "mirbft_engine_sim_ms": "Final simulated clock of a testengine Recorder run.",
     "mirbft_epoch_change_seconds": "Wall time from constructing an epoch change to activating the new epoch, per node observation.",
@@ -96,6 +103,13 @@ CATALOG_LABELS = {
     "mirbft_crypto_flush_seconds": ("plane",),
     "mirbft_crypto_flush_total": ("plane", "path"),
     "mirbft_crypto_items_total": ("plane", "path"),
+    "mirbft_device_hbm_bytes": (),
+    "mirbft_device_kernel_seconds": ("kernel",),
+    "mirbft_device_live_buffers": (),
+    "mirbft_device_live_buffer_bytes": (),
+    "mirbft_device_retraces_total": ("fn",),
+    "mirbft_device_transfer_bytes_total": ("direction",),
+    "mirbft_divergence_total": ("component",),
     "mirbft_engine_events_total": ("stage",),
     "mirbft_engine_sim_ms": ("stage",),
     "mirbft_epoch_change_seconds": (),

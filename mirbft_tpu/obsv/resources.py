@@ -247,6 +247,14 @@ class ResourceSampler:
         """Take one sample; safe to call without start() (tests)."""
         now = time.perf_counter() - self._t0
         sample = sample_process(self.dirs)
+        # Device-plane gauges ride the same cadence; memory_sample() is
+        # None-safe (no jax imported, or the backend raced away).
+        from .device import memory_sample
+
+        device = memory_sample()
+        if device is not None:
+            for key, value in device.items():
+                sample["device." + key] = value
         with self._lock:
             for name, value in sample.items():
                 if value is None:
@@ -257,6 +265,9 @@ class ResourceSampler:
                 "rss_bytes": "mirbft_resource_rss_bytes",
                 "open_fds": "mirbft_resource_open_fds",
                 "threads": "mirbft_resource_threads",
+                "device.live_buffers": "mirbft_device_live_buffers",
+                "device.live_buffer_bytes": "mirbft_device_live_buffer_bytes",
+                "device.hbm_bytes": "mirbft_device_hbm_bytes",
             }
             for key, metric in gauges.items():
                 if sample.get(key) is not None:
@@ -307,8 +318,14 @@ class ResourceSampler:
             return {name: list(pts) for name, pts in self.series.items()}
 
     def verdicts(self, **kwargs):
-        """Leak verdict per sampled metric family."""
+        """Leak verdict per sampled metric family.
+
+        ``device.*`` series are sampled and recorded but excluded from
+        the leak fit: live-buffer counts track jit-cache churn, not
+        process growth, and a growing-verdict there would gate PRs on
+        compiler behavior."""
         return {
             name: leak_verdict(pts, **kwargs)
             for name, pts in sorted(self.snapshot_series().items())
+            if not name.startswith("device.")
         }

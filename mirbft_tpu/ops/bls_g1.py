@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto import bls_host as host
+from ..obsv import device as _device
 
 NLIMB = 30
 RADIX = 13
@@ -222,6 +223,7 @@ def _from_mont(limbs) -> int:
     return limbs_to_int(limbs) * pow(R, -1, P_INT) % P_INT
 
 
+@_device.instrument("bls_aggregate")
 def aggregate_signatures(cert_sigs: list, voters: int | None = None):
     """Aggregate a batch of quorum certificates on the device.
 

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto import ed25519_host as host
+from ..obsv import device as _device
 
 NLIMB = 20
 RADIX = 13
@@ -278,6 +279,7 @@ def pack_rows(rows: list, batch_floor: int = 8):
     return s_bits, k_bits, neg_a, r_aff
 
 
+@_device.instrument("ed25519_verify")
 def verify_batch(
     pks: list, messages: list, signatures: list, chunk: int = 512
 ) -> np.ndarray:

@@ -45,6 +45,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ..crypto import ed25519_host as host
+from ..obsv import device as _device
 from .ed25519 import FOLD, MASK, NLIMB, RADIX, int_to_limbs
 
 LANES = 128
@@ -650,6 +651,7 @@ def launch_rows(rows: list, sublanes: int = 16):
     return out
 
 
+@_device.instrument("ed25519_verify_pallas")
 def verify_batch_pallas(
     pks: list,
     messages: list,

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obsv import device as _device
+
 # fmt: off
 _K = np.array([
     0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
@@ -126,6 +128,7 @@ def _sha256_blocks(blocks, n_blocks, *, max_blocks: int):
     return state
 
 
+@_device.instrument("sha256_digest")
 def sha256_digest_words(blocks, n_blocks):
     """Run the kernel on pre-packed blocks (see ops.batching)."""
     return _sha256_blocks(blocks, n_blocks, max_blocks=blocks.shape[1])
@@ -151,6 +154,13 @@ def sha256_chain_checksum(block, *, iters: int):
 
     state, _ = jax.lax.scan(body, state0, None, length=iters)
     return jnp.sum(state, dtype=jnp.uint32)
+
+
+# sync=False: the checksum's measurement protocol (one launch, scalar
+# readback as the only sync) must not gain a block_until_ready.
+sha256_chain_checksum = _device.instrument("sha256_chain", sync=False)(
+    sha256_chain_checksum
+)
 
 
 def sha256_chunked(chunk_lists: list) -> list:

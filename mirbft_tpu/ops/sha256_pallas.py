@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from ..obsv import device as _device
 from .sha256 import _IV, _K
 
 LANES = 128
@@ -181,6 +182,14 @@ def sha256_chain_checksum_pallas(block, *, iters: int, interpret: bool = False):
     return jnp.sum(words.astype(jnp.uint32), dtype=jnp.uint32)
 
 
+# sync=False for the same reason as ops.sha256.sha256_chain_checksum: the
+# chain microbench syncs via scalar readback only.
+sha256_chain_checksum_pallas = _device.instrument(
+    "sha256_chain_pallas", sync=False
+)(sha256_chain_checksum_pallas)
+
+
+@_device.instrument("sha256_digest_pallas")
 def sha256_digest_words_pallas(blocks, n_blocks, interpret: bool | None = None):
     """Drop-in for ops.sha256.sha256_digest_words: blocks (batch,
     max_blocks, 16) uint32, n_blocks (batch,) int32 -> (batch, 8) uint32.

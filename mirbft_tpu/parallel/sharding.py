@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obsv import device as _device
 from ..ops.sha256 import _sha256_blocks
 
 # jax >= 0.5 promotes shard_map to jax.shard_map (kwarg check_vma); on the
@@ -94,7 +95,9 @@ def sharded_sha256(mesh: Mesh):
         n_blocks = jax.device_put(np.asarray(n_blocks), batch_sharding)
         return digest(blocks, n_blocks)
 
-    return run
+    # Explicit fn_name: every factory's closure compiles as "run", which
+    # would fold all three families into one retrace counter.
+    return _device.instrument("sharded_sha256", fn_name="sharded_sha256")(run)
 
 
 def sharded_quorum_tally(mesh: Mesh):
@@ -128,7 +131,9 @@ def sharded_quorum_tally(mesh: Mesh):
         )
         return fn(votes, threshold)
 
-    return run
+    return _device.instrument(
+        "sharded_quorum_tally", fn_name="sharded_quorum_tally"
+    )(run)
 
 
 def sharded_ed25519_verify(mesh: Mesh):
@@ -169,4 +174,6 @@ def sharded_ed25519_verify(mesh: Mesh):
         r_affine = tuple(jax.device_put(np.asarray(c), row) for c in r_affine)
         return fn(s_bits, k_bits, neg_a, r_affine)
 
-    return run
+    return _device.instrument(
+        "sharded_ed25519_verify", fn_name="sharded_ed25519_verify"
+    )(run)

@@ -218,6 +218,18 @@ class Node:
         except queue.Empty:
             return None
 
+    def audit_divergence(self, timeout: float = 5.0):
+        """Run the scalar/vector divergence oracle (obsv.shadow) over this
+        node's client tracker, on the serializer thread (the tracker is
+        never safe to touch from outside it).  Returns the divergence list,
+        or None when the node is stopped or the audit timed out."""
+        reply: queue.Queue = queue.Queue(maxsize=1)
+        try:
+            self._put(("shadow_audit", reply))
+            return reply.get(timeout=timeout)
+        except (NodeStopped, queue.Empty):
+            return None
+
     def stop(self) -> None:
         """Idempotent, concurrency-safe shutdown: the first caller tears
         down (serializer joined, exporter closed); later and concurrent
@@ -405,6 +417,27 @@ class Node:
                     from ..status import state_machine_status
 
                     item[1].put(state_machine_status(self._machine))
+                elif kind == "shadow_audit":
+                    from ..obsv import shadow
+
+                    # An oracle bug must not crash a consensus node: report
+                    # it as a divergence record instead (callers fail the
+                    # audit loudly without losing the serializer).
+                    try:
+                        divs = shadow.audit_tracker(
+                            self._machine.client_tracker
+                        )
+                    except Exception as audit_err:
+                        divs = [
+                            {
+                                "component": "audit_error",
+                                "slot": -1,
+                                "client_id": -1,
+                                "req_no": -1,
+                                "detail": repr(audit_err),
+                            }
+                        ]
+                    item[1].put(divs)
                 else:
                     raise AssertionError(f"unknown inbox item {kind!r}")
         except BaseException as err:  # noqa: BLE001 — surfaced via exit_error

@@ -1,0 +1,420 @@
+"""Gates for the device-plane telemetry (obsv/device.py) and the
+scalar/vector divergence oracle (obsv/shadow.py): kernel histogram
+round-trips through the strict catalog, retrace-budget detection on
+shape-polymorphic callers, oracle regression coverage for the
+forward-request promotion and small-frame tick-refresh bugs, injected
+divergences caught by the sampling shadow within a stride, and the
+diff gate / journal recovery that make the bench artifact crash-proof.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import bench
+from mirbft_tpu import pb
+from mirbft_tpu.core.client_tracker import ClientTracker
+from mirbft_tpu.core.msgbuffers import NodeBuffers
+from mirbft_tpu.core.persisted import Persisted
+from mirbft_tpu.core.preimage import host_digest, request_hash_data
+from mirbft_tpu.obsv import device, hooks, shadow
+from mirbft_tpu.obsv.__main__ import main as obsv_main
+from mirbft_tpu.obsv.diff import (
+    apply_device_gate,
+    diff_files,
+    extract_series,
+    load_artifact,
+)
+from mirbft_tpu.obsv.metrics import CATALOG, CATALOG_LABELS, Registry
+from mirbft_tpu.obsv.recorder import FlightRecorder
+
+
+# -- tracker scaffolding (same idiom as test_client_tracker) ----------------
+
+
+def network_state(clients=((7, 100),), n=4, f=1, ci=5):
+    return pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(n)),
+            f=f,
+            number_of_buckets=n,
+            checkpoint_interval=ci,
+            max_epoch_length=50,
+        ),
+        clients=[
+            pb.NetworkClient(id=cid, width=width, low_watermark=0)
+            for cid, width in clients
+        ],
+    )
+
+
+def make_tracker(state=None):
+    persisted = Persisted()
+    persisted.add_c_entry(
+        pb.CEntry(
+            seq_no=0,
+            checkpoint_value=b"genesis",
+            network_state=state if state is not None else network_state(),
+        )
+    )
+    my = pb.InitialParameters(id=0, buffer_size=1 << 20)
+    ct = ClientTracker(persisted, NodeBuffers(my), my)
+    ct.reinitialize()
+    return ct
+
+
+def req(client_id=7, req_no=0, data=b"tx"):
+    r = pb.Request(client_id=client_id, req_no=req_no, data=data)
+    digest = host_digest(request_hash_data(r))
+    return r, pb.RequestAck(client_id=client_id, req_no=req_no, digest=digest)
+
+
+def ack_msg(ack):
+    return pb.Msg(type=ack)
+
+
+def build_mirror():
+    """Tracker with a live _FastAcks mirror: one large frame from node 1
+    (first-vote rows fall back per row, which refreshes each slot)."""
+    ct = make_tracker()
+    assert ct._fast_ok
+    acks = [req(req_no=i)[1] for i in range(40)]
+    ct.step_ack_many(1, [ack_msg(a) for a in acks])
+    assert ct._fast is not None
+    return ct, acks
+
+
+# -- device instrumentation --------------------------------------------------
+
+
+def test_instrument_is_passthrough_when_capture_off(monkeypatch):
+    device.reset()
+    monkeypatch.setattr(hooks, "enabled", False)
+
+    @device.instrument("toy")
+    def f(x):
+        return x * 2
+
+    assert f(3) == 6
+    # No capture registry, hooks off: nothing recorded anywhere.
+    assert device.report(Registry())["retraces"] == {}
+
+
+def test_kernel_histogram_roundtrips_through_strict_catalog():
+    device.reset()
+    reg = Registry()  # strict: undeclared names/labels raise KeyError
+    device.start_capture(reg)
+    try:
+
+        @device.instrument("toy_kernel")
+        def f(x):
+            return x + 1
+
+        a = np.zeros(16, dtype=np.uint32)
+        f(a)
+        f(a)
+        rep = device.report(reg)
+        kern = rep["kernel_seconds"]["toy_kernel"]
+        assert kern["count"] == 2
+        assert kern["total_s"] >= 0.0
+        assert kern["mean_ms"] >= 0.0
+        # Transfer estimate: args in, result out, both per call.
+        assert rep["transfer_bytes"]["h2d"] == 2 * a.nbytes
+        assert rep["transfer_bytes"]["d2h"] == 2 * a.nbytes
+        # One abstract signature -> exactly one retrace, no breach.
+        assert rep["retraces"] == {"f": 1}
+        assert rep["retrace_breaches"] == []
+        snap = reg.snapshot()
+        series = snap["mirbft_device_kernel_seconds"]["series"]
+        assert series[0]["labels"] == {"kernel": "toy_kernel"}
+    finally:
+        device.stop_capture()
+        device.reset()
+
+
+def test_device_metrics_are_cataloged_with_declared_labels():
+    expected = {
+        "mirbft_device_kernel_seconds": ("kernel",),
+        "mirbft_device_retraces_total": ("fn",),
+        "mirbft_device_transfer_bytes_total": ("direction",),
+        "mirbft_device_live_buffers": (),
+        "mirbft_device_live_buffer_bytes": (),
+        "mirbft_device_hbm_bytes": (),
+        "mirbft_divergence_total": ("component",),
+    }
+    for name, labels in expected.items():
+        assert name in CATALOG, name
+        assert CATALOG_LABELS[name] == labels, name
+
+
+def test_shape_polymorphic_caller_trips_retrace_budget():
+    device.reset()
+    reg = Registry()
+    device.start_capture(reg, retrace_budget=2)
+    try:
+
+        @device.instrument("poly", fn_name="poly")
+        def g(x):
+            return x
+
+        for n in range(1, 5):  # four distinct shapes -> four signatures
+            g(np.zeros(n, dtype=np.uint8))
+        rep = device.report(reg)
+        assert rep["retraces"]["poly"] == 4
+        assert rep["retrace_budget"] == 2
+        assert "poly" in rep["retrace_breaches"]
+        # The breach is an absolute diff-gate failure.
+        report = {"ok": True}
+        apply_device_gate(report, {"device": rep})
+        assert report["ok"] is False
+        [failure] = report["device_failures"]
+        assert failure["kind"] == "retrace_budget"
+        assert failure["series"] == "device.poly.retraces"
+    finally:
+        device.stop_capture()
+        device.reset()
+
+
+def test_sequence_lengths_bucket_to_pow2_signatures():
+    device.reset()
+    reg = Registry()
+    device.start_capture(reg)
+    try:
+
+        @device.instrument("seqy", fn_name="seqy")
+        def g(items):
+            return items
+
+        for n in (5, 6, 7, 8):  # all bucket to 8: one signature
+            g(list(range(n)))
+        assert device.report(reg)["retraces"]["seqy"] == 1
+        g(list(range(9)))  # bucket 16: a genuine retrace
+        assert device.report(reg)["retraces"]["seqy"] == 2
+    finally:
+        device.stop_capture()
+        device.reset()
+
+
+def test_memory_sample_matches_jax_presence():
+    sample = device.memory_sample()
+    if "jax" not in sys.modules:
+        assert sample is None
+    elif sample is not None:
+        assert set(sample) == {"live_buffers", "live_buffer_bytes", "hbm_bytes"}
+        assert all(isinstance(v, int) for v in sample.values())
+
+
+# -- divergence oracle -------------------------------------------------------
+
+
+def test_oracle_clean_on_converged_tracker():
+    ct, acks = build_mirror()
+    ct.step_ack_many(2, [ack_msg(a) for a in acks[:3]])  # loop path
+    ct.step_ack_many(3, [ack_msg(a) for a in acks])  # vector path
+    assert shadow.audit_tracker(ct) == []
+
+
+def test_forward_request_promotion_leaves_no_divergence():
+    """Regression (oracle form): apply_forward_request must run the full
+    weak/strong promotion when agreements cross a quorum, not only on
+    exact-threshold hits — any missed promotion is a 'weak' divergence."""
+    ct, acks = build_mirror()
+    r, ack = req(req_no=0)
+    fwd = pb.Msg(
+        type=pb.ForwardRequest(request_ack=ack, request_data=r.data)
+    )
+    ct.step(2, fwd)
+    ct.step(3, fwd)
+    crn = ct.client(7).req_no(0)
+    assert ack.digest in crn.weak_requests
+    assert ack.digest in crn.strong_requests
+    assert shadow.audit_tracker(ct) == []
+
+
+def test_oracle_catches_missed_weak_promotion():
+    """The old apply_forward_request bug's end state — votes accumulated
+    on the agreement mask without the weak/strong promotion — must be a
+    reported divergence, or the oracle proves nothing."""
+    ct, acks = build_mirror()
+    crn = ct.client(7).req_no(0)
+    reqobj = crn.requests[acks[0].digest]
+    # Bump the (mirror-attached) mask past both quorums out-of-band.
+    reqobj.agreements |= (1 << 2) | (1 << 3)
+    divs = shadow.audit_tracker(ct)
+    comps = {d["component"] for d in divs}
+    assert "weak" in comps and "strong" in comps
+    [weak] = [d for d in divs if d["component"] == "weak"]
+    assert weak["client_id"] == 7 and weak["req_no"] == 0
+
+
+def test_oracle_catches_stale_tick_class():
+    """The old small-frame bug left mirror slots with a stale tick class
+    after the python loop mutated the objects; the oracle must flag the
+    mirror/reference mismatch."""
+    ct, acks = build_mirror()
+    ct.step_ack_many(2, [ack_msg(acks[0])])  # weak crossing -> TICK_PYTHON
+    fast = ct._fast
+    slot = fast.slot_of(7, 0)
+    assert fast.tick_class[slot] == fast.TICK_PYTHON
+    assert shadow.audit_tracker(ct, [slot]) == []
+    fast.tick_class[slot] = fast.TICK_INERT  # simulate the missed refresh
+    divs = shadow.audit_tracker(ct, [slot])
+    assert [d["component"] for d in divs] == ["tick_class"]
+
+
+def test_shadow_sampler_catches_injected_divergence_within_stride(tmp_path):
+    ct, acks = build_mirror()
+    reg = Registry()
+    rec = FlightRecorder("shadow-test", dump_dir=str(tmp_path))
+    sampler = shadow.ShadowSampler(stride=2, registry=reg, recorder=rec)
+    hooks.shadow = sampler
+    try:
+        crn = ct.client(7).req_no(0)
+        reqobj = crn.requests[acks[0].digest]
+        reqobj.agreements |= (1 << 2) | (1 << 3)
+        # A second distinct-digest vote from node 1 hits the spam guard:
+        # each frame touches the poisoned slot but mutates nothing, so
+        # the divergence persists until a sampled frame audits it.
+        touch = req(req_no=0, data=b"conflicting")[1]
+        frames = 0
+        while not sampler.divergences and frames < 8:
+            ct.step_ack_many(1, [ack_msg(touch)])
+            frames += 1
+        assert sampler.divergences, "sampler never saw the divergence"
+        assert frames <= sampler.stride, "divergence not caught in one stride"
+        snap = reg.snapshot()
+        total = sum(
+            s["value"] for s in snap["mirbft_divergence_total"]["series"]
+        )
+        assert total >= 1
+        # First divergence dumps the flight-recorder ring for post-mortem.
+        assert sampler._dumped
+        assert any(tmp_path.iterdir()), "no flight-recorder dump written"
+    finally:
+        hooks.shadow = None
+
+
+# -- diff gate and journal recovery -----------------------------------------
+
+
+def _write(tmp_path, name, doc):
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def _device_section(**overrides):
+    section = {
+        "kernel_seconds": {},
+        "retraces": {},
+        "retrace_budget": 8,
+        "retrace_breaches": [],
+        "transfer_bytes": {},
+        "divergence_total": 0,
+    }
+    section.update(overrides)
+    return section
+
+
+def test_diff_gate_fails_on_breach_divergence_and_soak(tmp_path):
+    base = {"metric": "bench", "sha_per_sec": 10.0}
+    pa = _write(tmp_path, "a.json", dict(base, device=_device_section()))
+    assert obsv_main(["--diff", str(pa), str(pa)]) == 0
+
+    breach = dict(
+        base,
+        device=_device_section(retraces={"poly": 9}, retrace_breaches=["poly"]),
+    )
+    divergent = dict(base, device=_device_section(divergence_total=3))
+    soaked = dict(base, soak={"divergence": 2})
+    for bad in (breach, divergent, soaked):
+        pb_path = _write(tmp_path, "b.json", bad)
+        report = diff_files(pa, pb_path)
+        assert report["ok"] is False
+        assert report["device_failures"]
+        assert obsv_main(["--diff", str(pa), str(pb_path)]) == 1
+
+
+def test_device_series_extraction_gates_retraces_not_calls():
+    doc = {
+        "device": _device_section(
+            retraces={"fn_a": 3},
+            kernel_seconds={
+                "k": {"count": 7, "total_s": 0.7, "mean_ms": 100.0}
+            },
+            transfer_bytes={"h2d": 1024},
+        )
+    }
+    series = extract_series(doc)
+    assert series["device.fn_a.retraces"] == 3.0
+    assert series["device.k.mean_ms"] == 100.0
+    assert series["device.k.calls"] == 7.0
+    from mirbft_tpu.obsv.diff import direction
+
+    assert direction("device.fn_a.retraces") == "lower"
+    assert direction("device.k.mean_ms") == "lower"
+    # Launch counts vary run-to-run and must never gate.
+    assert direction("device.k.calls") is None
+
+
+def test_load_artifact_prefers_journal_final_line(tmp_path):
+    payload = {"metric": "bench", "sha_per_sec": 10.0}
+    lines = [
+        {"schema": "mirbft-bench-stream/1", "kind": "header", "pid": 123},
+        {"kind": "stage", "stage": "sha", "seconds": 1.5, "status": "ok"},
+        {"kind": "final", "payload": payload},
+    ]
+    path = tmp_path / "BENCH_stream.jsonl"
+    path.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+    assert load_artifact(path) == payload
+
+
+def test_load_artifact_rebuilds_killed_run_from_stage_lines(tmp_path):
+    lines = [
+        json.dumps(
+            {"schema": "mirbft-bench-stream/1", "kind": "header", "pid": 99}
+        ),
+        json.dumps(
+            {"kind": "stage", "stage": "sha", "seconds": 1.5, "status": "ok"}
+        ),
+        json.dumps(
+            {"kind": "stage", "stage": "ed", "seconds": 2.5, "status": "ok"}
+        ),
+    ]
+    path = tmp_path / "BENCH_stream.jsonl"
+    # SIGKILL mid-write: the tail line is torn and must be skipped.
+    path.write_text("\n".join(lines) + "\n" + '{"kind": "stage", "sta')
+    doc = load_artifact(path)
+    assert doc["recovered"] is True
+    assert doc["schema"].startswith("mirbft-bench-recovered")
+    assert doc["pid"] == 99
+    assert doc["stages"]["sha"]["seconds"] == 1.5
+    series = extract_series(doc)
+    assert series["stage.sha.seconds"] == 1.5
+    assert series["stage.ed.seconds"] == 2.5
+
+
+def test_bench_recover_cli_prints_recovered_json(tmp_path, capsys):
+    payload = {"metric": "bench", "sha_per_sec": 10.0}
+    path = tmp_path / "BENCH_stream.jsonl"
+    path.write_text(
+        json.dumps({"schema": "mirbft-bench-stream/1", "kind": "header"})
+        + "\n"
+        + json.dumps({"kind": "final", "payload": payload})
+        + "\n"
+    )
+    assert bench.recover_main([str(path)]) == 0
+    assert json.loads(capsys.readouterr().out) == payload
+    assert bench.recover_main([str(tmp_path / "missing.jsonl")]) == 1
+    assert "error" in json.loads(capsys.readouterr().out)
+
+
+def test_bench_budget_clamps_to_harness_timeout():
+    grace = bench.WATCHDOG_GRACE_S + bench.HARNESS_MARGIN_S
+    env = {"BENCH_BUDGET_S": "100000", "BENCH_HARNESS_TIMEOUT_S": "870"}
+    assert bench.effective_budget_s(env) == 870.0 - grace
+    env = {"BENCH_BUDGET_S": "120", "BENCH_HARNESS_TIMEOUT_S": "870"}
+    assert bench.effective_budget_s(env) == 120.0
+    # The defaults already fit under the harness timeout with margin.
+    assert bench.effective_budget_s({}) == bench.DEFAULT_BUDGET_S

@@ -371,6 +371,57 @@ def test_linter_confines_resource_introspection_to_obsv(tmp_path):
     assert not any("W14" in line for line in lint.check_file(tests_ok))
 
 
+def test_linter_confines_device_sync_to_kernel_layer(tmp_path):
+    """W15: jax.profiler and block_until_ready belong to obsv/device.py
+    and ops/; a stray device sync in protocol code serializes the
+    pipeline and scattered profiler sessions fight over the trace
+    backend."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "core" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("def f(x):\n    return x.block_until_ready()\n")
+    findings = lint.check_file(outside)
+    assert any("W15" in line for line in findings), findings
+
+    profiled = tmp_path / "mirbft_tpu" / "runtime" / "sneaky2.py"
+    profiled.parent.mkdir(parents=True)
+    profiled.write_text("import jax.profiler\nx = jax.profiler\n")
+    assert any("W15" in line for line in lint.check_file(profiled))
+
+    fromstyle = tmp_path / "mirbft_tpu" / "chaos" / "sneaky3.py"
+    fromstyle.parent.mkdir(parents=True)
+    fromstyle.write_text(
+        "from jax.profiler import start_trace\nx = start_trace\n"
+    )
+    assert any("W15" in line for line in lint.check_file(fromstyle))
+
+    # The kernel layer and the instrumentation wrapper are sanctioned.
+    ops_ok = tmp_path / "mirbft_tpu" / "ops" / "kernel.py"
+    ops_ok.parent.mkdir(parents=True)
+    ops_ok.write_text("def f(x):\n    return x.block_until_ready()\n")
+    assert not any("W15" in line for line in lint.check_file(ops_ok))
+
+    device_ok = tmp_path / "mirbft_tpu" / "obsv" / "device.py"
+    device_ok.parent.mkdir(parents=True)
+    device_ok.write_text("def f(x):\n    return x.block_until_ready()\n")
+    assert not any("W15" in line for line in lint.check_file(device_ok))
+
+    # The real wrapper is the sanctioned caller.
+    assert not any(
+        "W15" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "obsv" / "device.py"
+        )
+    )
+
+    # Tests, tools, and bench are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text("def f(x):\n    return x.block_until_ready()\n")
+    assert not any("W15" in line for line in lint.check_file(tests_ok))
+
+
 def test_linter_confines_adversary_tooling_to_harness(tmp_path):
     """W13: core/ and runtime/ must not import mirbft_tpu.testengine or
     mirbft_tpu.chaos in any spelling — payload mutation and frame

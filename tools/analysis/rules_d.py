@@ -77,7 +77,12 @@ IMPURE_MODULES: dict[str, str] = {
 # instrumented module records through (guarded by ``hooks.enabled``);
 # it is the Python port's analog of the reference's Actions seam — the
 # one doorway through which the pure world touches the impure one.
-BOUNDARY_MODULES = frozenset({"mirbft_tpu.obsv.hooks"})
+# device is the same seam for the kernel layer: ops/ entry points time
+# themselves through it, and the wrapper is a passthrough (one module
+# load and a branch) unless a capture registry is installed.
+BOUNDARY_MODULES = frozenset(
+    {"mirbft_tpu.obsv.hooks", "mirbft_tpu.obsv.device"}
+)
 
 # module -> {stdlib top-level name: justification}.  Mirrored in
 # docs/ANALYSIS.md; every entry is a documented hole in the proof.

@@ -1,6 +1,7 @@
-"""General defect classes W1..W14 (the original tools/lint.py checks as
+"""General defect classes W1..W15 (the original tools/lint.py checks as
 Rule objects, message-compatible, plus the seeded-randomness ban and the
-adversary-tooling and resource-introspection confinements).
+adversary-tooling, resource-introspection, and device-timing
+confinements).
 
 The catalog (rationale per rule lives in docs/ANALYSIS.md):
 
@@ -30,6 +31,12 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   — process introspection (RSS, fd counts, rusage) goes through the
   obsv resource sampler so the sampling cadence, gauge names, and leak
   fits stay in one place.
+- W15 ``jax.profiler`` / ``block_until_ready`` outside
+  ``mirbft_tpu/obsv/device.py`` and ``mirbft_tpu/ops/`` — device
+  synchronization and profiler hooks are confined to the kernel layer
+  and its instrumentation wrapper.  A stray ``block_until_ready`` in
+  protocol code serializes the device pipeline (a silent perf cliff),
+  and scattered profiler sessions fight over the single trace backend.
 """
 
 from __future__ import annotations
@@ -199,6 +206,23 @@ def in_resource_ban_scope(posix: str) -> bool:
     """True for mirbft_tpu files where W14 bans process-introspection
     imports."""
     return "mirbft_tpu/" in posix and RESOURCE_ALLOWED_FILE not in posix
+
+
+# The only places allowed to force device synchronization or open
+# profiler sessions: the kernel layer itself and the obsv device
+# instrumentation wrapper that times it.
+DEVICE_TIMING_ALLOWED_FILE = "mirbft_tpu/obsv/device.py"
+DEVICE_TIMING_ALLOWED_TREE = "mirbft_tpu/ops/"
+
+
+def in_device_timing_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W15 bans ``jax.profiler`` and
+    ``block_until_ready``."""
+    return (
+        "mirbft_tpu/" in posix
+        and DEVICE_TIMING_ALLOWED_FILE not in posix
+        and DEVICE_TIMING_ALLOWED_TREE not in posix
+    )
 
 
 def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
@@ -466,6 +490,60 @@ def _check_w14(ctx: FileContext):
                 "resource/psutil outside obsv/resources.py (process "
                 "introspection goes through the obsv resource sampler)",
             )
+
+
+def _check_w15(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "block_until_ready":
+                yield Finding(
+                    "W15",
+                    ctx.path,
+                    node.lineno,
+                    "block_until_ready outside obsv/device.py and ops/ "
+                    "(device sync serializes the pipeline; time kernels "
+                    "through obsv.device.instrument)",
+                )
+            elif (
+                node.attr == "profiler"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"
+            ):
+                yield Finding(
+                    "W15",
+                    ctx.path,
+                    node.lineno,
+                    "jax.profiler outside obsv/device.py and ops/ "
+                    "(profiler sessions are confined to the device "
+                    "instrumentation layer)",
+                )
+        elif isinstance(node, ast.Import):
+            if any(
+                alias.name == "jax.profiler"
+                or alias.name.startswith("jax.profiler.")
+                for alias in node.names
+            ):
+                yield Finding(
+                    "W15",
+                    ctx.path,
+                    node.lineno,
+                    "jax.profiler outside obsv/device.py and ops/ "
+                    "(profiler sessions are confined to the device "
+                    "instrumentation layer)",
+                )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module is not None and (
+                node.module == "jax.profiler"
+                or node.module.startswith("jax.profiler.")
+            ):
+                yield Finding(
+                    "W15",
+                    ctx.path,
+                    node.lineno,
+                    "jax.profiler outside obsv/device.py and ops/ "
+                    "(profiler sessions are confined to the device "
+                    "instrumentation layer)",
+                )
 
 
 # random attributes that do NOT carry module-global RNG state.
@@ -759,5 +837,19 @@ register(
         ),
         check=_as_list(_check_w14),
         scope=in_resource_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W15",
+        title="device sync/profiler outside the kernel layer",
+        doc=(
+            "jax.profiler and block_until_ready are confined to "
+            "mirbft_tpu/obsv/device.py and mirbft_tpu/ops/; protocol "
+            "code must not force device synchronization or open "
+            "profiler sessions."
+        ),
+        check=_as_list(_check_w15),
+        scope=in_device_timing_ban_scope,
     )
 )
