@@ -167,6 +167,239 @@ SOAK_PUSH_S = 0.25
 DEFAULT_SOAK_S = 30.0
 
 
+# Ackplane rung: host vs device ack/quorum plane at >=100k clients (the
+# docs/DEVICE_TRACKER.md rung).  Identical seeded ack storms — every
+# node acks every client's req 0 in a shuffled order — are absorbed by a
+# host-plane tracker (step_ack_many: scalar fallbacks + the _FastAcks
+# columnar path) and by the device plane's column-native ingest
+# (submit_columns + flush).  The frame size divides the client count so
+# every device batch pads to one power-of-two bucket (one jit signature
+# for the whole storm).  Each side's first frame is its untimed
+# build/compile window; events/s compares steady state only.  Boundary
+# drain (materializing adoptions/crossings back into the host objects)
+# is device-plane-only cost and is reported separately.
+ACKPLANE_CLIENTS = int(os.environ.get("BENCH_ACKPLANE_CLIENTS", "100000"))
+ACKPLANE_FRAME = ACKPLANE_CLIENTS // 8
+ACKPLANE_SOURCES = (1, 2, 3)
+ACKPLANE_SEED = 0xACC5
+ACKPLANE_AUDIT_SLOTS = 2048
+
+
+def _ackplane_tracker(n_clients, ack_plane):
+    """A standalone ClientTracker at bench scale (no engine): genesis
+    checkpoint with n_clients width-1 windows, 4 nodes f=1."""
+    from mirbft_tpu import pb
+    from mirbft_tpu.core.client_tracker import ClientTracker
+    from mirbft_tpu.core.msgbuffers import NodeBuffers
+    from mirbft_tpu.core.persisted import Persisted
+
+    state = pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=[0, 1, 2, 3],
+            f=1,
+            number_of_buckets=4,
+            checkpoint_interval=5,
+            max_epoch_length=50,
+        ),
+        clients=[
+            pb.NetworkClient(id=cid, width=1, low_watermark=0)
+            for cid in range(n_clients)
+        ],
+    )
+    persisted = Persisted()
+    persisted.add_c_entry(
+        pb.CEntry(
+            seq_no=0, checkpoint_value=b"genesis", network_state=state
+        )
+    )
+    my = pb.InitialParameters(id=0, buffer_size=1 << 20)
+    ct = ClientTracker(persisted, NodeBuffers(my), my, ack_plane=ack_plane)
+    ct.reinitialize()
+    return ct
+
+
+def ackplane_run(registry=None):
+    """Host vs device ack plane under the same seeded ack storm.
+
+    Returns the rung dict merged into the payload under ackplane_* keys:
+    steady-state ack events/s per plane (and the device/host ratio),
+    committed (strong-certified) reqs/s on the device plane, the
+    boundary drain cost, the sampled divergence-oracle verdict, and a
+    sampled cross-plane object-parity check.  Divergences are also
+    recorded as ``mirbft_divergence_total`` so the standard device gate
+    (``obsv --diff``) fails the run on any of them."""
+    from mirbft_tpu import pb
+    from mirbft_tpu.obsv import hooks, shadow
+
+    n_clients = ACKPLANE_CLIENTS
+    rng = np.random.default_rng(ACKPLANE_SEED)
+    dig_mat = rng.integers(0, 256, size=(n_clients, 32), dtype=np.uint8)
+    orders = {s: rng.permutation(n_clients) for s in ACKPLANE_SOURCES}
+
+    if registry is not None:
+        hooks.enable(registry=registry)
+    try:
+        # -- host plane ------------------------------------------------------
+        host = _ackplane_tracker(n_clients, "host")
+        host_build_s = host_steady_s = 0.0
+        host_steady_events = 0
+        first = True
+        for s in ACKPLANE_SOURCES:
+            order = orders[s]
+            for lo in range(0, n_clients, ACKPLANE_FRAME):
+                idx = order[lo : lo + ACKPLANE_FRAME]
+                msgs = [
+                    pb.Msg(
+                        type=pb.RequestAck(
+                            client_id=int(c),
+                            req_no=0,
+                            digest=dig_mat[c].tobytes(),
+                        )
+                    )
+                    for c in idx.tolist()
+                ]
+                t0 = time.perf_counter()
+                host.step_ack_many(s, msgs)
+                dt = time.perf_counter() - t0
+                if first:
+                    host_build_s, first = dt, False
+                else:
+                    host_steady_s += dt
+                    host_steady_events += len(msgs)
+
+        # -- device plane ----------------------------------------------------
+        devt = _ackplane_tracker(n_clients, "device")
+        t0 = time.perf_counter()
+        plane = devt._build_device() if devt._device_ok else None
+        dev_build_s = time.perf_counter() - t0
+        if plane is None:
+            return {
+                "host_events_per_sec": _round(
+                    host_steady_events / host_steady_s
+                    if host_steady_s
+                    else None
+                ),
+                "device_events_per_sec": None,
+                "detail": "device plane unavailable (no jax device)",
+            }
+        zeros = np.zeros(ACKPLANE_FRAME, dtype=np.int64)
+        dev_compile_s = dev_steady_s = 0.0
+        dev_steady_events = 0
+        out_of_window = 0
+        first = True
+        for s in ACKPLANE_SOURCES:
+            order = orders[s]
+            for lo in range(0, n_clients, ACKPLANE_FRAME):
+                idx = order[lo : lo + ACKPLANE_FRAME].astype(np.int64)
+                t0 = time.perf_counter()
+                out = plane.submit_columns(
+                    s, idx, zeros[: len(idx)], dig_mat[idx]
+                )
+                plane.flush(drain=None)
+                dt = time.perf_counter() - t0
+                out_of_window += len(out)
+                if first:
+                    dev_compile_s, first = dt, False
+                else:
+                    dev_steady_s += dt
+                    dev_steady_events += len(idx)
+
+        # Boundary drain: adoptions, weak/strong crossings, ready marks
+        # materialize into the host objects (column-only ingest, so any
+        # fallback row raises — the zero-fallback gate).
+        t0 = time.perf_counter()
+        plane.drain_events(devt)
+        drain_s = time.perf_counter() - t0
+        # Quorum-certificate tally across every (client, window) bucket
+        # in one device pass.
+        t0 = time.perf_counter()
+        certs = plane.quorum_sweep()
+        sweep_s = time.perf_counter() - t0
+
+        # Sampled divergence audit (the same oracle the chaos invariant
+        # runs); any finding lands in mirbft_divergence_total and fails
+        # the standard device gate.
+        sample = rng.choice(
+            n_clients,
+            size=min(ACKPLANE_AUDIT_SLOTS, n_clients),
+            replace=False,
+        )
+        slots = [int(c) * plane.w_pad for c in sample.tolist()]
+        divs = shadow.audit_tracker(devt, slots=slots)
+        if registry is not None:
+            for d in divs:
+                registry.counter(
+                    "mirbft_divergence_total", component=d["component"]
+                ).inc()
+
+        # Cross-plane parity on the same sampled clients: both trackers
+        # absorbed the identical storm, so the host objects must match
+        # the device plane's authoritative state slot for slot (the
+        # device-side *objects* hold stale lower bounds by contract, so
+        # voter masks read from the device snapshot).
+        from mirbft_tpu.core.device_tracker import _combine_limbs
+
+        dev_snap = plane.host_snapshot()
+        parity_mismatches = 0
+        for c in sample.tolist():
+            h = host.clients[c].req_no_map[0]
+            d = devt.clients[c].req_no_map[0]
+            slot = int(c) * plane.w_pad
+            if (
+                set(h.strong_requests) != set(d.strong_requests)
+                or set(h.weak_requests) != set(d.weak_requests)
+                or h.non_null_voters
+                != _combine_limbs(dev_snap["nonnull"][slot])
+            ):
+                parity_mismatches += 1
+
+        total_events = len(ACKPLANE_SOURCES) * n_clients
+        host_rate = (
+            host_steady_events / host_steady_s if host_steady_s else None
+        )
+        dev_rate = (
+            dev_steady_events / dev_steady_s if dev_steady_s else None
+        )
+        committed_rate = (
+            certs["strong_certs"] / (dev_steady_s + dev_compile_s + sweep_s)
+            if dev_steady_s + dev_compile_s + sweep_s > 0
+            else None
+        )
+        counters = {}
+        if registry is not None:
+            snap = registry.snapshot().get("mirbft_ack_events_total") or {}
+            for series in snap.get("series", ()):
+                plane_label = dict(series["labels"]).get("plane")
+                counters[plane_label] = series["value"]
+        return {
+            "clients": n_clients,
+            "events_total": total_events,
+            "host_events_per_sec": _round(host_rate),
+            "host_build_s": _round(host_build_s, 3),
+            "device_events_per_sec": _round(dev_rate),
+            "device_build_s": _round(dev_build_s, 3),
+            "device_compile_s": _round(dev_compile_s, 3),
+            "device_vs_host": (
+                round(dev_rate / host_rate, 3)
+                if dev_rate and host_rate
+                else None
+            ),
+            "committed_reqs_per_sec": _round(committed_rate),
+            "strong_certs": certs["strong_certs"],
+            "weak_certs": certs["weak_certs"],
+            "drain_seconds": _round(drain_s, 3),
+            "sweep_seconds": _round(sweep_s, 3),
+            "fallback_rows": plane.acks_fallback,
+            "dropped_rows": plane.acks_dropped + out_of_window,
+            "divergences": len(divs),
+            "parity_mismatches": parity_mismatches,
+            "ack_events_counter": counters,
+        }
+    finally:
+        if registry is not None:
+            hooks.disable()
+
+
 def sha256_microbench_warmup():
     """Compile both chain kernels and the Pallas digest shape before the
     timed microbench: the stage's ``compile_s`` is this function's wall,
@@ -1591,6 +1824,7 @@ def main() -> int:
         r5 if r5 is not None else (None, None, None)
     )
     _fold_engine(registry, "rung5", rung5_events, r5_sim)
+    ackplane = runner.run("ackplane", lambda: ackplane_run(registry))
 
     total_reqs = CLIENTS * REQS_PER_CLIENT
     committed_rate = total_reqs / tpu_wall if tpu_wall else None
@@ -1725,6 +1959,25 @@ def main() -> int:
             "HEAVY-gated correctness tier)"
         ),
         "rung5_engine_events": rung5_events,
+        # Ackplane rung: host vs device ack/quorum plane (see
+        # docs/DEVICE_TRACKER.md).  Flattened to top-level ackplane_*
+        # keys so obsv --diff gates events/s and the device/host ratio
+        # like any other headline number; divergences found by the
+        # sampled oracle audit also land in device.divergence_total.
+        **{
+            f"ackplane_{k}": v
+            for k, v in (ackplane or {}).items()
+            if k != "ack_events_counter"
+        },
+        "ackplane_config": (
+            f"{ACKPLANE_CLIENTS} clients (width-1 windows), 4 nodes f=1, "
+            f"{len(ACKPLANE_SOURCES)} sources acking every req 0 in "
+            f"seeded shuffled frames of {ACKPLANE_FRAME}; events/s is "
+            "steady state (each plane's first frame is its build/compile "
+            "window); committed = strong-certified slots per second of "
+            "device ingest + quorum sweep; boundary drain reported "
+            "separately"
+        ),
         # Soak rung: resource series + least-squares leak verdicts;
         # `obsv --diff` fails the run when any verdict is "growing" —
         # RSS/fd/disk regressions gate PRs exactly like p95 regressions.

@@ -109,8 +109,10 @@ def check_no_vector_divergence(rec) -> None:
     """The ``_FastAcks`` vector ack path provably agrees with the scalar
     reference path on every node: the shadow oracle (obsv.shadow)
     re-derives weak/strong/available membership and tick classes from the
-    mirror's masks and diffs them against the live objects.  Vacuous on
-    nodes that never built a mirror (the scalar path IS the reference).
+    mirror's masks and diffs them against the live objects; trackers
+    running the device ack plane (core.device_tracker) are audited the
+    same way against their dense arrays.  Vacuous on nodes that never
+    built either plane (the scalar path IS the reference).
 
     Unlike the other invariants this one reads protocol-internal state,
     not harness evidence — it is exactly the determinism precondition Mir
@@ -119,7 +121,10 @@ def check_no_vector_divergence(rec) -> None:
 
     for node in range(rec.node_count):
         tracker = rec.machines[node].client_tracker
-        if getattr(tracker, "_fast", None) is None:
+        if (
+            getattr(tracker, "_fast", None) is None
+            and getattr(tracker, "_device", None) is None
+        ):
             continue
         divs = shadow.audit_tracker(tracker)
         if divs:

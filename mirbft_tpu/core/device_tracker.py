@@ -1,0 +1,947 @@
+"""Device-resident client/quorum plane: dense bitmask ack tracking with
+on-device popcount quorums, sharded across chips.
+
+Mir's multi-leader design makes ack/quorum bookkeeping the per-request
+hot path — every RequestAck from every node touches it, O(n^2)
+applications per request — and PBFT's quorum rules are pure
+popcount-over-bitmask logic.  This module moves that plane onto the
+accelerator: per-slot agreement/non-null masks, canonical digests,
+committed flags and tick classes live as dense ``(clients × window)``
+jax arrays, and one jitted ``step_ack_batch`` kernel absorbs a whole
+columnar ack batch — canonical adoption, the one-non-null-vote spam
+guard, mask OR, popcount quorum crossings and the tick reclassification
+— in a single fused program.  The client axis is sharded across chips
+via ``parallel.sharding``'s Mesh + shard_map (each chip owns a
+contiguous block of clients; per-row outputs merge with a psum).
+
+``DeviceClientPlane`` is the host facade.  The authority contract
+(mirroring ``_FastAcks``, which remains the host-side reference
+implementation):
+
+- While the plane is live, per-slot vote masks (``agree``/``nonnull``)
+  and canonical digests are authoritative ON DEVICE.  The owning
+  ``ClientRequest``/``ClientReqNo`` objects hold stale lower bounds.
+- Only *boundary outputs* materialize back to the host ``ClientTracker``
+  after each kernel run: canonical adoptions (the slot's first vote),
+  weak/strong quorum crossings (newly-available requests, certificate
+  completion), ready-mark hits, and rows the dense representation cannot
+  express (fallback rows replay through the scalar ``step_ack`` path).
+- The tracker keeps canonical ownership of windows and allocation.  Any
+  host path that reads or mutates a slot's ack state calls
+  ``sync_slot``: the device row is pulled into the objects and the slot
+  becomes host-authoritative (``staged``) until the next flush
+  re-derives it object→device — the exact analogue of
+  ``_FastAcks.refresh``.
+- Window-structure changes (checkpoint allocation, GC, reinitialize)
+  ``drop()`` the plane; it rebuilds lazily, like the host mirror.
+
+Shapes are fixed per plane: the window axis is padded to a power of two
+and ack batches are padded to power-of-two row buckets, so the jit
+cache sees a handful of signatures for the whole run (asserted by the
+``obsv.device`` retrace budget).  docs/DEVICE_TRACKER.md documents the
+array layouts, the pad policy and this boundary contract.
+
+This is the single module inside ``mirbft_tpu/core/`` allowed to import
+jax (lint rule W16); the purity auditor treats it as a boundary module
+(tools/analysis/rules_d.py), like ``obsv.device``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..obsv import hooks
+
+# Tick classes and flags shared with _FastAcks (same values, same meaning).
+COMMITTED = 1
+SLOW = 2
+TICK_INERT = 0
+TICK_STEADY = 1
+TICK_PYTHON = 2
+
+#: Batch rows are padded to the next power of two, floored here, so the
+#: whole run compiles at most log2(max/min)+1 batch signatures.
+MIN_BATCH_ROWS = 1024
+MAX_BATCH_ROWS = 65536
+
+
+def resolve_ack_plane(explicit: str | None = None) -> str:
+    """Resolve the ack-plane selection: explicit config wins, then the
+    ``MIRBFT_ACK_PLANE`` environment knob, then the host default."""
+    plane = explicit if explicit is not None else os.environ.get(
+        "MIRBFT_ACK_PLANE", "host"
+    )
+    if plane not in ("host", "device"):
+        raise ValueError(f"ack_plane must be host|device, got {plane!r}")
+    return plane
+
+
+def device_plane_available() -> bool:
+    """True when jax imports and exposes at least one device.  The
+    tracker calls this once per reinitialize; a False (missing jax,
+    broken platform plugin) cleanly falls back to the host path."""
+    try:
+        import jax
+
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def classify_tick_device(
+    committed: bool, slow: bool, count: int, held: bool,
+    my_or_weak: bool, weak_q: int,
+) -> int:
+    """The device plane's tick-class contract (the reference the oracle
+    audits the ``tick_class`` array against).  Committed slots are inert;
+    slots the dense representation cannot express (``slow``) take the
+    python path whenever any request state exists; otherwise the class is
+    pure popcount: a held canonical rebroadcasts on the steady cadence, a
+    weak-quorum canonical we do not hold needs fetch ticks (python), and
+    anything below the weak quorum with nothing held is inert."""
+    if committed:
+        return TICK_INERT
+    if slow:
+        return TICK_PYTHON if my_or_weak else TICK_INERT
+    if held:
+        return TICK_STEADY
+    if count >= weak_q:
+        return TICK_PYTHON
+    return TICK_INERT
+
+
+def digest_words(dig_mat: np.ndarray) -> np.ndarray:
+    """(rows, 32) uint8 digest matrix -> (rows, 8) little-endian uint32
+    words (the device-side digest representation)."""
+    return np.ascontiguousarray(dig_mat).view("<u4")
+
+
+def words_to_digest(words: np.ndarray) -> bytes:
+    return np.ascontiguousarray(words, dtype="<u4").tobytes()
+
+
+def _combine_limbs(row: np.ndarray) -> int:
+    value = 0
+    for limb in range(row.shape[0] - 1, -1, -1):
+        value = (value << 32) | int(row[limb])
+    return value
+
+
+def _split_limbs(value: int, limbs: int) -> list:
+    mask = (1 << 32) - 1
+    return [(value >> (32 * i)) & mask for i in range(limbs)]
+
+
+# ---------------------------------------------------------------------------
+# The jitted ack kernel
+# ---------------------------------------------------------------------------
+
+
+def _build_step_kernel(mesh, *, c_pad, w_pad, limbs, weak_q, strong_q):
+    """Compile-time factory for ``step_ack_batch``: one fused program
+    that applies a columnar ack batch against the dense slot state.
+
+    State arrays are sharded over the client axis (``P(AXIS)``); batch
+    columns are replicated and each shard applies the rows belonging to
+    its client block, so the only collective is the psum that merges the
+    per-row boundary outputs (each row has exactly one owner shard)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obsv import device as _device
+    from ..parallel.sharding import AXIS, _CHECK_OFF, _shard_map
+
+    n_shards = mesh.devices.size
+    block = c_pad // n_shards
+    s_loc = block * w_pad
+    keys_total = s_loc * limbs
+
+    def local(agree, nonnull, canon, canon_ok, flags, held, tick,
+              ci, w, src, dig, valid):
+        ax = jax.lax.axis_index(AXIS)
+        lci = ci - ax * block
+        mine = valid & (lci >= 0) & (lci < block)
+        flat = jnp.where(mine, lci, 0) * w_pad + jnp.where(mine, w, 0)
+
+        agree_f = agree.reshape(s_loc, limbs)
+        nonnull_f = nonnull.reshape(s_loc, limbs)
+        canon_f = canon.reshape(s_loc, 8)
+        cok_f = canon_ok.reshape(s_loc)
+        tick_f = tick.reshape(s_loc)
+        fl = flags.reshape(s_loc)[flat]
+
+        committed = mine & ((fl & COMMITTED) != 0)
+        slow = mine & ((fl & SLOW) != 0)
+        live = mine & ~committed & ~slow
+
+        n_rows = ci.shape[0]
+        idx = jnp.arange(n_rows, dtype=jnp.int32)
+
+        # Canonical adoption: the first live row (batch order) targeting
+        # a virgin slot adopts its digest — the scalar path's "first
+        # vote creates the entry" rule, done as a scatter-min race.
+        virgin = live & ~cok_f[flat]
+        first = jnp.full((s_loc,), n_rows, dtype=jnp.int32).at[flat].min(
+            jnp.where(virgin, idx, n_rows)
+        )
+        adopt = virgin & (first[flat] == idx)
+        tgt = jnp.where(adopt, flat, s_loc)  # out-of-range rows drop
+        canon_f = canon_f.at[tgt].set(dig, mode="drop")
+        cok_f = cok_f.at[tgt].set(True, mode="drop")
+
+        match = live & (canon_f[flat] == dig).all(axis=1)
+
+        # Spam guard against pre-batch masks: a voter whose non-null vote
+        # went to a different digest gets no second vote.  (Same-source
+        # same-slot conflicts inside one batch always involve a
+        # non-canonical digest, which lands in the fallback path.)
+        limb = src >> 5
+        bit = (jnp.uint32(1) << (src & 31).astype(jnp.uint32))
+        old_a_limb = agree_f[flat, limb]
+        old_n_limb = nonnull_f[flat, limb]
+        dup = (old_a_limb & bit) != 0
+        foreign = ((old_n_limb & bit) != 0) & ~dup
+        apply_r = match & ~foreign
+        fallback = mine & ~committed & ~apply_r
+
+        # Segment-OR the batch into the masks: lex-sort rows by
+        # (slot·limb key, source), drop duplicate (key, bit) pairs, and
+        # sum distinct bits per segment (sum of distinct bits == OR).
+        key = jnp.where(apply_r, flat * limbs + limb, keys_total)
+        o1 = jnp.argsort(src, stable=True)
+        o2 = jnp.argsort(key[o1], stable=True)
+        order = o1[o2]
+        k_s = key[order]
+        b_s = bit[order]
+        a_s = apply_r[order]
+        prev_k = jnp.concatenate([jnp.full((1,), -1, k_s.dtype), k_s[:-1]])
+        prev_b = jnp.concatenate([jnp.zeros((1,), b_s.dtype), b_s[:-1]])
+        dup_in_batch = (k_s == prev_k) & (b_s == prev_b)
+        contrib = jnp.where(a_s & ~dup_in_batch, b_s, jnp.uint32(0))
+        seg_start = k_s != prev_k
+        seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+        delta = jnp.zeros((n_rows,), jnp.uint32).at[seg_id].add(contrib)
+
+        oldrow = agree_f[flat]  # (rows, limbs), pre-update
+
+        row_delta = delta[seg_id]
+        tgt_keys = jnp.where(seg_start & a_s, k_s, keys_total)
+        safe = jnp.minimum(tgt_keys, keys_total - 1)
+        agree_lin = agree_f.reshape(keys_total)
+        nn_lin = nonnull_f.reshape(keys_total)
+        add_a = row_delta & ~agree_lin[safe]
+        add_n = row_delta & ~nn_lin[safe]
+        agree_f = agree_lin.at[tgt_keys].add(add_a, mode="drop").reshape(
+            s_loc, limbs
+        )
+        nonnull_f = nn_lin.at[tgt_keys].add(add_n, mode="drop").reshape(
+            s_loc, limbs
+        )
+
+        # Popcount quorum tallies: counts are per-slot (all limbs), and
+        # every row of a crossing slot reports the crossing — the host
+        # dedupes by slot when materializing.
+        pc = jax.lax.population_count
+        oldcount = jnp.where(
+            apply_r, pc(oldrow).sum(axis=1, dtype=jnp.int32), 0
+        )
+        newcount = jnp.where(
+            apply_r, pc(agree_f[flat]).sum(axis=1, dtype=jnp.int32), 0
+        )
+        weak_x = apply_r & (oldcount < weak_q) & (newcount >= weak_q)
+        strong_x = apply_r & (oldcount < strong_q) & (newcount >= strong_q)
+
+        # Tick reclassification by popcount (classify_tick_device's
+        # non-slow branch; slow slots never reach here).
+        h = held.reshape(s_loc)[flat]
+        new_tick = jnp.where(
+            h,
+            jnp.uint8(TICK_STEADY),
+            jnp.where(
+                newcount >= weak_q,
+                jnp.uint8(TICK_PYTHON),
+                jnp.uint8(TICK_INERT),
+            ),
+        )
+        tick_f = tick_f.at[jnp.where(apply_r, flat, s_loc)].set(
+            new_tick, mode="drop"
+        )
+
+        def merged(x, dtype=jnp.int32):
+            return jax.lax.psum(x.astype(dtype), AXIS)
+
+        outs = (
+            merged(apply_r),
+            merged(fallback),
+            merged(committed),
+            merged(adopt),
+            merged(weak_x),
+            merged(strong_x),
+            merged(newcount),
+        )
+        return (
+            agree_f.reshape(block, w_pad, limbs),
+            nonnull_f.reshape(block, w_pad, limbs),
+            canon_f.reshape(block, w_pad, 8),
+            cok_f.reshape(block, w_pad),
+            flags,
+            held,
+            tick_f.reshape(block, w_pad),
+        ) + outs
+
+    from jax.sharding import PartitionSpec as P
+
+    state_spec = (P(AXIS),) * 7
+    batch_spec = (P(),) * 5
+    fn = jax.jit(
+        _shard_map(
+            local,
+            mesh=mesh,
+            in_specs=state_spec + batch_spec,
+            out_specs=state_spec + (P(),) * 7,
+            # Per-row outputs are psum-merged to replicated; varying-
+            # manual-axes checking would demand pcasts for no semantic
+            # gain (same rationale as sharded_sha256).
+            **_CHECK_OFF,
+        )
+    )
+    return _device.instrument(
+        "device_ack_step", fn_name="device_ack_step"
+    )(fn)
+
+
+def _build_sweep_kernel(mesh, *, c_pad, w_pad, limbs, weak_q, strong_q):
+    """(clients × window) digest-agreement reduction: quorum-certificate
+    tallies for every leader bucket in one pass, plus a full tick_class
+    recompute from the same popcounts."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obsv import device as _device
+    from ..parallel.sharding import AXIS, _CHECK_OFF, _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(agree, canon_ok, flags, held, tick0):
+        counts = jax.lax.population_count(agree).sum(
+            axis=2, dtype=jnp.int32
+        )
+        live = canon_ok & (flags == 0)
+        weak = live & (counts >= weak_q)
+        strong = live & (counts >= strong_q)
+        committed = (flags & COMMITTED) != 0
+        # Non-live rows (SLOW / committed) keep their host-derived class:
+        # the device popcounts cannot reconstruct the my_or_weak knowledge
+        # that picked it.
+        tick = jnp.where(
+            live,
+            jnp.where(
+                held,
+                jnp.uint8(TICK_STEADY),
+                jnp.where(
+                    weak,
+                    jnp.uint8(TICK_PYTHON),
+                    jnp.uint8(TICK_INERT),
+                ),
+            ),
+            jnp.where(committed, jnp.uint8(TICK_INERT), tick0),
+        )
+
+        def total(x):
+            return jax.lax.psum(x.astype(jnp.int32).sum(), AXIS)
+
+        return total(weak), total(strong), total(committed), tick
+
+    fn = jax.jit(
+        _shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(AXIS),) * 5,
+            out_specs=(P(), P(), P(), P(AXIS)),
+            **_CHECK_OFF,
+        )
+    )
+    return _device.instrument(
+        "device_quorum_sweep", fn_name="device_quorum_sweep"
+    )(fn)
+
+
+# ---------------------------------------------------------------------------
+# Host facade
+# ---------------------------------------------------------------------------
+
+
+class DeviceClientPlane:
+    """Batches incoming acks, runs the device kernel, and materializes
+    only the boundary outputs back into the host ``ClientTracker``.
+
+    Built from a live tracker (same construction contract as
+    ``_FastAcks``); dropped on any window-structure change."""
+
+    def __init__(self, tracker, mesh=None):
+        import jax
+
+        from ..parallel import sharding as _sharding
+        from .quorum import intersection_quorum, some_correct_quorum
+
+        if mesh is None:
+            mesh = _sharding.make_mesh()
+        self.mesh = mesh
+        nc = tracker.network_config
+        self.weak_q = some_correct_quorum(nc)
+        self.strong_q = intersection_quorum(nc)
+        self.limbs = ((max(nc.nodes) >> 5) + 1) if nc.nodes else 1
+
+        clients = tracker.clients
+        cids = sorted(clients)
+        self.cid0 = cids[0]
+        self.n_clients = cids[-1] - cids[0] + 1
+        n_shards = mesh.devices.size
+        self.c_pad = max(_pow2(self.n_clients), n_shards)
+        w_max = 1
+        for cid in cids:
+            c = clients[cid]
+            w_max = max(w_max, c.high_watermark - c.low_watermark + 1)
+        self.w_pad = _pow2(w_max)
+        self.total = self.c_pad * self.w_pad
+
+        # Host-owned window metadata (windows never move during the
+        # plane's lifetime: structure changes drop it).
+        self.base_arr = np.zeros(self.n_clients + 1, dtype=np.int64)
+        self.low_arr = np.zeros(self.n_clients + 1, dtype=np.int64)
+        self.high_arr = np.full(self.n_clients + 1, -1, dtype=np.int64)
+        self.nrm_arr = np.full(self.n_clients + 1, -1, dtype=np.int64)
+        self.clients: list = [None] * (self.n_clients + 1)
+        self.canon_req: list = [None] * self.total
+        self.canon_crn: list = [None] * self.total
+
+        agree = np.zeros((self.total, self.limbs), dtype=np.uint32)
+        nonnull = np.zeros((self.total, self.limbs), dtype=np.uint32)
+        canon = np.zeros((self.total, 8), dtype=np.uint32)
+        canon_ok = np.zeros(self.total, dtype=bool)
+        flags = np.zeros(self.total, dtype=np.uint8)
+        held = np.zeros(self.total, dtype=bool)
+        tick = np.zeros(self.total, dtype=np.uint8)
+
+        # Phantom rows (window padding and the dense-id gaps) are SLOW so
+        # no kernel row can ever apply against them.
+        flags[:] = SLOW
+        for cid in cids:
+            ci = cid - self.cid0
+            client = clients[cid]
+            self.clients[ci] = client
+            self.base_arr[ci] = client.low_watermark
+            self.low_arr[ci] = client.low_watermark
+            self.high_arr[ci] = client.high_watermark
+            self.nrm_arr[ci] = client.next_ready_mark
+            size = client.high_watermark - client.low_watermark + 1
+            offset = ci * self.w_pad
+            for i in range(size):
+                slot = offset + i
+                crn = client.req_no_map.get(client.low_watermark + i)
+                self.canon_crn[slot] = crn
+                (
+                    agree[slot], nonnull[slot], canon[slot],
+                    canon_ok[slot], flags[slot], held[slot], tick[slot],
+                    self.canon_req[slot],
+                ) = self._derive_row(crn)
+
+        shape3 = (self.c_pad, self.w_pad)
+        row = _sharding.client_axis_sharding(mesh)
+        put = jax.device_put
+        self._dev = [
+            put(agree.reshape(shape3 + (self.limbs,)), row),
+            put(nonnull.reshape(shape3 + (self.limbs,)), row),
+            put(canon.reshape(shape3 + (8,)), row),
+            put(canon_ok.reshape(shape3), row),
+            put(flags.reshape(shape3), row),
+            put(held.reshape(shape3), row),
+            put(tick.reshape(shape3), row),
+        ]
+        self._batch_sharding = _sharding.replicated_sharding(mesh)
+        self._step = _build_step_kernel(
+            mesh, c_pad=self.c_pad, w_pad=self.w_pad, limbs=self.limbs,
+            weak_q=self.weak_q, strong_q=self.strong_q,
+        )
+        self._sweep = _build_sweep_kernel(
+            mesh, c_pad=self.c_pad, w_pad=self.w_pad, limbs=self.limbs,
+            weak_q=self.weak_q, strong_q=self.strong_q,
+        )
+
+        self._staged: dict = {}  # slot -> True (host-authoritative)
+        self._snapshot: dict | None = None
+        self._pending: list = []  # [(src, ci, w, rno, dig_words, msgs?)]
+        self._pending_rows = 0
+        self._events: list = []  # flush boundary outputs awaiting drain
+        # Cumulative plane counters (bench/report surface).
+        self.acks_applied = 0
+        self.acks_dropped = 0
+        self.acks_fallback = 0
+        self.batches = 0
+
+    # -- slot math -----------------------------------------------------------
+
+    def slot_of(self, client_id: int, req_no: int) -> int | None:
+        ci = client_id - self.cid0
+        if not (0 <= ci < self.n_clients):
+            return None
+        if not (self.low_arr[ci] <= req_no <= self.high_arr[ci]):
+            return None
+        return ci * self.w_pad + int(req_no - self.base_arr[ci])
+
+    def _ident(self, slot: int) -> tuple:
+        ci = slot // self.w_pad
+        return ci + self.cid0, int(self.base_arr[ci]) + slot % self.w_pad
+
+    # -- object -> device (staged refresh) -----------------------------------
+
+    def _derive_row(self, crn):
+        """Re-derive one slot's dense row from the authoritative objects
+        (the device analogue of ``_FastAcks._refresh_slot``)."""
+        from .client_tracker import _NULL
+
+        za = np.zeros(self.limbs, dtype=np.uint32)
+        zc = np.zeros(8, dtype=np.uint32)
+        if crn is None:
+            return za, za, zc, False, SLOW, False, TICK_INERT, None
+        if crn.committed is not None:
+            return za, za, zc, False, COMMITTED, False, TICK_INERT, None
+        requests = crn.requests
+        if not requests:
+            # Virgin slot: the kernel may adopt its first digest.
+            return za, za, zc, False, 0, False, TICK_INERT, None
+        canonical = len(requests) == 1 and _NULL not in requests
+        if canonical:
+            (digest,) = requests
+            req = requests[digest]
+            fetchy = any(
+                (not cr.stored) or cr.fetching
+                for cr in crn.weak_requests.values()
+            )
+            if not fetchy:
+                agree = np.asarray(
+                    _split_limbs(req.agreements, self.limbs), dtype=np.uint32
+                )
+                nonnull = np.asarray(
+                    _split_limbs(crn.non_null_voters, self.limbs),
+                    dtype=np.uint32,
+                )
+                canon = digest_words(
+                    np.frombuffer(digest, dtype=np.uint8)
+                ).reshape(8)
+                held = digest in crn.my_requests and crn.acks_sent > 0
+                count = req.agreements.bit_count()
+                tick = classify_tick_device(
+                    False, False, count, held, True, self.weak_q
+                )
+                return agree, nonnull, canon, True, 0, held, tick, req
+        # Conflicting digests, a null request in play, or fetch machinery
+        # in motion: the slot is host-authoritative (rows fall back).
+        my_or_weak = bool(crn.my_requests or crn.weak_requests)
+        tick = classify_tick_device(
+            False, True, 0, False, my_or_weak, self.weak_q
+        )
+        return za, za, zc, False, SLOW, False, tick, None
+
+    def sync_slot(self, client_id: int, req_no: int) -> None:
+        """Hand one slot back to the objects: pull the device masks into
+        the owning request/req-no, then mark the slot staged so the next
+        flush re-derives it object→device.  Idempotent until that flush."""
+        slot = self.slot_of(client_id, req_no)
+        if slot is None:
+            return
+        if slot in self._staged:
+            return
+        if self._pending_rows:
+            self.flush(drain=None)
+        self._staged[slot] = True
+        snap = self.host_snapshot()
+        if snap["canon_ok"][slot] and not snap["flags"][slot]:
+            req = self.canon_req[slot]
+            crn = self.canon_crn[slot]
+            if req is not None:
+                req._agreements = _combine_limbs(snap["agree"][slot])
+            if crn is not None:
+                crn._non_null_voters = _combine_limbs(snap["nonnull"][slot])
+
+    def mark_committed(self, client_id: int, req_no: int) -> None:
+        slot = self.slot_of(client_id, req_no)
+        if slot is not None:
+            self._staged[slot] = True
+
+    def _flush_staged(self) -> None:
+        if not self._staged:
+            return
+        import jax.numpy as jnp
+
+        slots = np.fromiter(
+            self._staged, dtype=np.int64, count=len(self._staged)
+        )
+        self._staged = {}
+        k = len(slots)
+        agree = np.zeros((k, self.limbs), dtype=np.uint32)
+        nonnull = np.zeros((k, self.limbs), dtype=np.uint32)
+        canon = np.zeros((k, 8), dtype=np.uint32)
+        canon_ok = np.zeros(k, dtype=bool)
+        flags = np.zeros(k, dtype=np.uint8)
+        held = np.zeros(k, dtype=bool)
+        tick = np.zeros(k, dtype=np.uint8)
+        for i, slot in enumerate(slots.tolist()):
+            cid, rno = self._ident(slot)
+            client = self.clients[slot // self.w_pad]
+            crn = client.req_no_map.get(rno) if client is not None else None
+            self.canon_crn[slot] = crn
+            (
+                agree[i], nonnull[i], canon[i], canon_ok[i], flags[i],
+                held[i], tick[i], self.canon_req[slot],
+            ) = self._derive_row(crn)
+        ci = slots // self.w_pad
+        w = slots % self.w_pad
+        dev = self._dev
+        dev[0] = dev[0].at[ci, w].set(jnp.asarray(agree))
+        dev[1] = dev[1].at[ci, w].set(jnp.asarray(nonnull))
+        dev[2] = dev[2].at[ci, w].set(jnp.asarray(canon))
+        dev[3] = dev[3].at[ci, w].set(jnp.asarray(canon_ok))
+        dev[4] = dev[4].at[ci, w].set(jnp.asarray(flags))
+        dev[5] = dev[5].at[ci, w].set(jnp.asarray(held))
+        dev[6] = dev[6].at[ci, w].set(jnp.asarray(tick))
+        self._snapshot = None
+
+    # -- device -> host ------------------------------------------------------
+
+    def host_snapshot(self) -> dict:
+        """Host numpy view of the dense state (one transfer, cached until
+        the next flush or staged write invalidates it)."""
+        snap = self._snapshot
+        if snap is None:
+            names = (
+                "agree", "nonnull", "canon", "canon_ok", "flags", "held",
+                "tick_class",
+            )
+            snap = {
+                name: np.asarray(arr).reshape((self.total,) + arr.shape[2:])
+                for name, arr in zip(names, self._dev)
+            }
+            self._snapshot = snap
+        return snap
+
+    # -- batch ingest --------------------------------------------------------
+
+    def submit_columns(self, source, ids, rnos, dig_mat, msgs=None):
+        """Queue one columnar ack batch (the plane's native ingest: the
+        boundary between transport framing and the dense state is these
+        four columns).  ``msgs`` carries the originating pb messages when
+        available so fallback rows can replay through the scalar path;
+        column-only callers must not produce fallback rows (asserted by
+        the bench rung's zero-fallback gate).
+
+        Rows outside the dense window (unknown clients, out-of-window
+        req_nos) are returned as an index array for the caller to route
+        through the tracker's buffering rules."""
+        ci = np.asarray(ids, dtype=np.int64) - self.cid0
+        rnos = np.asarray(rnos, dtype=np.int64)
+        known = (ci >= 0) & (ci < self.n_clients)
+        cis = np.where(known, ci, self.n_clients)
+        in_win = (rnos >= self.low_arr[cis]) & (rnos <= self.high_arr[cis])
+        out_rows = np.flatnonzero(~in_win)
+        keep = in_win if len(out_rows) else slice(None)
+        w = (rnos - self.base_arr[cis])[keep]
+        self._pending.append(
+            (
+                int(source),
+                cis[keep].astype(np.int32),
+                w.astype(np.int32),
+                rnos[keep],
+                digest_words(dig_mat[keep]),
+                [msgs[i] for i in np.flatnonzero(in_win)]
+                if (msgs is not None and len(out_rows))
+                else msgs,
+            )
+        )
+        self._pending_rows += int(in_win.sum()) if len(out_rows) else len(
+            rnos
+        )
+        return out_rows
+
+    def flush(self, drain) -> None:
+        """Run the kernel over everything queued; buffer the boundary
+        outputs (drained into the tracker by ``drain_events``, or
+        immediately when ``drain`` is the owning tracker)."""
+        if not self._pending_rows:
+            if drain is not None:
+                self.drain_events(drain)
+            return
+        import jax
+
+        self._flush_staged()
+        pending, self._pending = self._pending, []
+        n = self._pending_rows
+        self._pending_rows = 0
+
+        ci = np.concatenate([p[1] for p in pending])
+        w = np.concatenate([p[2] for p in pending])
+        rnos = np.concatenate([p[3] for p in pending])
+        dig = np.concatenate([p[4] for p in pending])
+        src = np.concatenate(
+            [np.full(len(p[1]), p[0], dtype=np.int32) for p in pending]
+        )
+        rows = min(max(_pow2(n), MIN_BATCH_ROWS), MAX_BATCH_ROWS)
+        while rows < n:
+            rows <<= 1
+        valid = np.zeros(rows, dtype=bool)
+        valid[:n] = True
+        pad = rows - n
+
+        def padded(a, fill=0):
+            if not pad:
+                return a
+            return np.concatenate(
+                [a, np.full((pad,) + a.shape[1:], fill, dtype=a.dtype)]
+            )
+
+        put = jax.device_put
+        bs = self._batch_sharding
+        out = self._step(
+            *self._dev,
+            put(padded(ci), bs),
+            put(padded(w), bs),
+            put(padded(src), bs),
+            put(padded(dig), bs),
+            put(valid, bs),
+        )
+        self._dev = list(out[:7])
+        self._snapshot = None
+        applied, fb, dropped, adopt, weak_x, strong_x, newcount = (
+            np.asarray(o)[:n].astype(
+                bool if i < 6 else np.int32
+            )
+            for i, o in enumerate(out[7:])
+        )
+        self.batches += 1
+        self.acks_applied += int(applied.sum())
+        self.acks_dropped += int(dropped.sum())
+        self.acks_fallback += int(fb.sum())
+        if hooks.enabled:
+            hooks.record_ack_batch("device", n)
+
+        # Ready-mark hits are detected host-side (next_ready_mark is
+        # host-owned and moves during drains).
+        nrm_hit = applied & (rnos == self.nrm_arr[ci]) & (
+            newcount >= self.strong_q
+        )
+        slots = ci.astype(np.int64) * self.w_pad + w
+        msgs_rows = None
+        if any(p[5] is not None for p in pending):
+            msgs_rows = []
+            for p in pending:
+                if p[5] is None:
+                    msgs_rows.extend([None] * len(p[1]))
+                else:
+                    msgs_rows.extend(
+                        (p[0], m) for m in p[5]
+                    )
+        self._events.append(
+            {
+                "slots": slots,
+                "rnos": rnos,
+                "dig": dig,
+                "applied": applied,
+                "adopt": adopt,
+                "weak": weak_x,
+                "strong": strong_x,
+                "nrm_hit": nrm_hit,
+                "msgs": msgs_rows,
+                "fallback": fb,
+            }
+        )
+        if drain is not None:
+            self.drain_events(drain)
+
+    def drain_events(self, tracker) -> None:
+        """Materialize buffered boundary outputs into the host objects:
+        adopted canonicals become ``ClientRequest`` entries, weak
+        crossings feed the available list, strong crossings complete
+        certificates and may advance the ready mark, and fallback rows
+        replay through the scalar reference path."""
+        if not self._events:
+            return
+        from . import client_tracker as _ct
+        from .. import pb
+
+        events, self._events = self._events, []
+        w_pad = self.w_pad
+        canon_req = self.canon_req
+        canon_crn = self.canon_crn
+        for ev in events:
+            slots = ev["slots"]
+            rnos = ev["rnos"]
+            adopt_rows = np.flatnonzero(ev["adopt"])
+            for r in adopt_rows.tolist():
+                slot = int(slots[r])
+                crn = canon_crn[slot]
+                if crn is None:
+                    continue
+                digest = words_to_digest(ev["dig"][r])
+                req = crn.requests.get(digest)
+                if req is None:
+                    req = _ct.ClientRequest(
+                        ack=pb.RequestAck(
+                            client_id=crn.client_id,
+                            req_no=crn.req_no,
+                            digest=digest,
+                        )
+                    )
+                    crn.requests[digest] = req
+                canon_req[slot] = req
+
+            snap = None
+            for name, member in (("weak", "weak_requests"),
+                                 ("strong", "strong_requests")):
+                cross = np.flatnonzero(ev[name])
+                if not len(cross):
+                    continue
+                if snap is None:
+                    snap = self.host_snapshot()
+                seen = set()
+                for r in cross.tolist():
+                    slot = int(slots[r])
+                    if slot in seen:
+                        continue
+                    seen.add(slot)
+                    req = canon_req[slot]
+                    crn = canon_crn[slot]
+                    if req is None or crn is None:
+                        continue
+                    digest = req.ack.digest
+                    bucket = getattr(crn, member)
+                    if digest in bucket:
+                        continue
+                    bucket[digest] = req
+                    # Crossings carry the mask back to the object so
+                    # fetch targeting sees the voters the device saw.
+                    req._agreements = _combine_limbs(snap["agree"][slot])
+                    if name == "weak" and not req.garbage:
+                        tracker.available_list.push_back(req)
+
+            applied_slots = np.unique(slots[ev["applied"]])
+            for slot in applied_slots.tolist():
+                client = self.clients[slot // w_pad]
+                if client is not None:
+                    client._tick_pending.add(
+                        int(self.base_arr[slot // w_pad]) + slot % w_pad
+                    )
+
+            for r in np.flatnonzero(ev["nrm_hit"]).tolist():
+                slot = int(slots[r])
+                crn = canon_crn[slot]
+                client = self.clients[slot // w_pad]
+                if crn is not None and client is not None:
+                    if crn.strong_requests:
+                        tracker.check_ready(client, crn)
+
+            fb_rows = np.flatnonzero(ev["fallback"])
+            if len(fb_rows):
+                msgs_rows = ev["msgs"]
+                if msgs_rows is None:
+                    raise AssertionError(
+                        "column-only ingest produced fallback rows; "
+                        "replay needs the originating messages"
+                    )
+                for r in fb_rows.tolist():
+                    entry = msgs_rows[r]
+                    if entry is None:
+                        continue
+                    source, msg = entry
+                    # step_ack syncs the slot itself via the tracker's
+                    # device branch.
+                    tracker.step_ack(source, msg)
+
+    # -- tracker entry points ------------------------------------------------
+
+    def apply_frame(self, tracker, source: int, msgs: list) -> None:
+        """One inbound ack frame, end to end: columnize, kernel, drain.
+        Out-of-window rows take the tracker's buffering rules (the same
+        verdicts the scalar path reaches)."""
+        from .client_tracker import _frame_columns
+
+        ids, rnos, dig_mat, irregular = _frame_columns(msgs)
+        if irregular is not None:
+            # Null/odd-length digests cannot be dense rows; replay them
+            # through the scalar path after the vector rows (the same
+            # ordering relaxation _step_ack_vector documents).
+            keep = np.ones(len(msgs), dtype=bool)
+            keep[irregular] = False
+            out_rows = self.submit_columns(
+                source, ids[keep], rnos[keep], dig_mat[keep],
+                msgs=[m for i, m in enumerate(msgs) if keep[i]],
+            )
+            tail = [msgs[i] for i in irregular]
+        else:
+            out_rows = self.submit_columns(
+                source, ids, rnos, dig_mat, msgs=msgs
+            )
+            tail = []
+        self.flush(drain=tracker)
+        for r in np.asarray(out_rows).tolist():
+            tracker.step_ack(source, msgs[r])  # buffers / drops per verdict
+        for msg in tail:
+            tracker.step_ack(source, msg)
+
+    def quorum_sweep(self) -> dict:
+        """Tally quorum certificates across every (client, window) bucket
+        in one device pass; refreshes the tick_class plane from the same
+        popcounts."""
+        self._flush_staged()
+        weak, strong, committed, tick = self._sweep(
+            self._dev[0], self._dev[3], self._dev[4], self._dev[5],
+            self._dev[6],
+        )
+        self._dev[6] = tick
+        self._snapshot = None
+        return {
+            "weak_certs": int(weak),
+            "strong_certs": int(strong),
+            "committed": int(committed),
+        }
+
+    def mark_committed_bulk(self, slots: np.ndarray) -> None:
+        """Flag many slots committed in one scatter (bench/commit-drain
+        path; the per-request path stages through ``mark_committed``)."""
+        import jax.numpy as jnp
+
+        slots = np.asarray(slots, dtype=np.int64)
+        ci = slots // self.w_pad
+        w = slots % self.w_pad
+        dev = self._dev
+        dev[4] = dev[4].at[ci, w].set(np.uint8(COMMITTED))
+        dev[6] = dev[6].at[ci, w].set(np.uint8(TICK_INERT))
+        dev[3] = dev[3].at[ci, w].set(False)
+        self._snapshot = None
+
+    def drop(self, tracker) -> None:
+        """Materialize everything back into the objects before the plane
+        is discarded (window moves, GC, reinitialize) — the device
+        analogue of ``ClientTracker._drop_fast``."""
+        self.flush(drain=tracker)
+        snap = self.host_snapshot()
+        canon_ok = snap["canon_ok"]
+        flags = snap["flags"]
+        agree = snap["agree"]
+        nonnull = snap["nonnull"]
+        for slot in np.flatnonzero(canon_ok & (flags == 0)).tolist():
+            if slot in self._staged:
+                continue  # objects already authoritative
+            req = self.canon_req[slot]
+            crn = self.canon_crn[slot]
+            if req is not None:
+                req._agreements = _combine_limbs(agree[slot])
+            if crn is not None:
+                crn._non_null_voters = _combine_limbs(nonnull[slot])

@@ -182,6 +182,23 @@ def epoch_milestone(name, node, epoch):
                 )
 
 
+def record_ack_batch(plane, n):
+    """Record one ack frame/batch absorbed by an ack plane: event count
+    plus batch-size distribution, labeled ``plane="host"`` (the
+    _FastAcks/scalar paths in step_ack_many) or ``plane="device"`` (one
+    device_tracker kernel flush).  The bench ackplane rung derives its
+    events/s keys from these counters."""
+    m = metrics
+    if m is None:
+        return
+    from .metrics import ACK_BATCH_BUCKETS
+
+    m.counter("mirbft_ack_events_total", plane=plane).inc(n)
+    m.histogram(
+        "mirbft_ack_batch_size", ACK_BATCH_BUCKETS, plane=plane
+    ).observe(n)
+
+
 def record_flush(plane, path, items, seconds=None):
     """Record one crypto-plane flush/launch/readback: how many digests or
     verdicts moved through which path (device, host, readback, rescued,

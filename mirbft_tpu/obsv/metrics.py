@@ -37,6 +37,8 @@ import threading
 # name -> help text.  Keep sorted; tests assert every key appears in
 # docs/OBSERVABILITY.md.
 CATALOG = {
+    "mirbft_ack_batch_size": "RequestAck frame/batch sizes entering an ack plane, by plane (host = step_ack_many frames, device = kernel flushes).",
+    "mirbft_ack_events_total": "RequestAck events absorbed by an ack plane, by plane (host _FastAcks/scalar path vs device bitmask plane).",
     "mirbft_bench_stage_compile_seconds": "bench.py per-stage warmup/compile seconds (JAX/Mosaic compiles triggered before the timed window).",
     "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
     "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/malformed).",
@@ -92,6 +94,8 @@ CATALOG = {
 # outside this set, so a new dimension cannot ship undocumented (the
 # docs test checks every label name below against docs/OBSERVABILITY.md).
 CATALOG_LABELS = {
+    "mirbft_ack_batch_size": ("plane",),
+    "mirbft_ack_events_total": ("plane",),
     "mirbft_bench_stage_compile_seconds": ("stage",),
     "mirbft_bench_stage_seconds": ("stage",),
     "mirbft_byzantine_rejections_total": ("kind",),
@@ -152,6 +156,10 @@ CATALOG_LABELS = {
 DEFAULT_CARDINALITY = 256
 CARDINALITY = {
     "mirbft_seq_milestones_total": 4096,
+    # Two closed planes (host/device) x {counter, histogram}: keep the
+    # budget tight so a label typo cannot silently mint series.
+    "mirbft_ack_batch_size": 4,
+    "mirbft_ack_events_total": 4,
 }
 
 
@@ -172,6 +180,10 @@ DEFAULT_BUCKETS = (
     1.0,
     5.0,
 )
+
+# Size buckets (rows) for mirbft_ack_batch_size: powers of four from a
+# single ack up to the device plane's max kernel bucket (65536 rows).
+ACK_BATCH_BUCKETS = (1, 4, 16, 64, 256, 1024, 4096, 16384, 65536)
 
 
 class Counter:

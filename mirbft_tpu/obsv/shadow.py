@@ -39,6 +39,8 @@ Divergence components:
 
 from __future__ import annotations
 
+import os
+
 from .metrics import CardinalityError
 
 #: Audit every Nth ack frame by default.  The audit is O(touched slots)
@@ -46,6 +48,20 @@ from .metrics import CardinalityError
 #: under the obsv budget while still catching a fork within a handful
 #: of frames (asserted by the injected-divergence test).
 DEFAULT_STRIDE = 16
+
+
+def resolve_stride(stride=None) -> int:
+    """Sampler stride resolution: explicit value (Config.shadow_stride or
+    a direct constructor arg) wins, then the ``MIRBFT_SHADOW_STRIDE`` env
+    knob, then :data:`DEFAULT_STRIDE`.  Large-fleet rungs dial this up to
+    cut audit overhead without losing the oracle
+    (docs/OBSERVABILITY.md#shadow-oracle)."""
+    if stride is not None:
+        return max(1, int(stride))
+    env = os.environ.get("MIRBFT_SHADOW_STRIDE")
+    if env:
+        return max(1, int(env))
+    return DEFAULT_STRIDE
 
 
 def _slot_ident(fast, slot):
@@ -147,10 +163,14 @@ def audit_tracker(tracker, slots=None):
     an iterable of slot indices to audit a frame's touched subset.
     Vacuously empty when the tracker has no live mirror — the scalar
     path IS the reference, there is nothing to diverge.
+
+    A tracker running the device ack plane (core.device_tracker) is
+    audited the same way against its dense arrays — slot indices then
+    refer to the device plane's layout (only one plane is ever live).
     """
     fast = getattr(tracker, "_fast", None)
     if fast is None:
-        return []
+        return audit_device_plane(tracker, slots)
     fast.flush_canon_rows()
     avail_ids = _available_ids(tracker)
     if slots is None:
@@ -159,6 +179,137 @@ def audit_tracker(tracker, slots=None):
     for slot in slots:
         crn = fast.canon_crn[slot]
         out.extend(_slot_divergences(fast, slot, crn, avail_ids))
+    return out
+
+
+def audit_device_plane(tracker, slots=None):
+    """Diff the device ack plane's dense arrays against the scalar rules
+    — the device analogue of the ``_FastAcks`` audit above, with the same
+    divergence components.  Flushing pending batches first is the sync
+    point; staged (host-authoritative) slots are skipped by contract —
+    their array rows are stale by design until the next flush re-derives
+    them (docs/DEVICE_TRACKER.md)."""
+    dev = getattr(tracker, "_device", None)
+    if dev is None:
+        return []
+    import numpy as np
+
+    from ..core.device_tracker import (
+        COMMITTED,
+        SLOW,
+        classify_tick_device,
+    )
+
+    dev.flush(drain=tracker)
+    snap = dev.host_snapshot()
+    avail_ids = _available_ids(tracker)
+    if slots is None:
+        slots = range(dev.total)
+    staged = dev._staged
+    agree = snap["agree"]
+    canon_ok = snap["canon_ok"]
+    flags_arr = snap["flags"]
+    held_arr = snap["held"]
+    tick_arr = snap["tick_class"]
+    out = []
+    for slot in slots:
+        if slot in staged:
+            continue
+        ci = slot // dev.w_pad
+        if ci >= dev.n_clients or dev.clients[ci] is None:
+            continue  # client-axis padding / dense-id gap: phantom rows
+        crn = dev.canon_crn[slot]
+        flags = int(flags_arr[slot])
+        client_id, req_no = dev._ident(slot)
+
+        def div(component, detail, *, _slot=slot, _cid=client_id,
+                _rno=req_no):
+            return {
+                "component": component,
+                "slot": int(_slot),
+                "client_id": _cid,
+                "req_no": _rno,
+                "detail": detail,
+            }
+
+        if flags & COMMITTED:
+            if crn is None or crn.committed is None:
+                out.append(
+                    div("committed", "device COMMITTED but object uncommitted")
+                )
+            continue
+        if crn is None:
+            continue
+
+        got_cls = int(tick_arr[slot])
+        if not (flags & SLOW) and canon_ok[slot]:
+            req = dev.canon_req[slot]
+            if req is None:
+                out.append(
+                    div(
+                        "membership",
+                        "device canonical slot with no materialized request",
+                    )
+                )
+                continue
+            key = req.ack.digest
+            count = int(np.bitwise_count(agree[slot]).sum())
+            in_weak = key in crn.weak_requests
+            if in_weak != (count >= dev.weak_q):
+                out.append(
+                    div(
+                        "weak",
+                        f"popcount {count} (weak_q {dev.weak_q}) vs "
+                        f"weak_requests membership {in_weak}",
+                    )
+                )
+            in_strong = key in crn.strong_requests
+            if in_strong != (count >= dev.strong_q):
+                out.append(
+                    div(
+                        "strong",
+                        f"popcount {count} (strong_q {dev.strong_q}) vs "
+                        f"strong_requests membership {in_strong}",
+                    )
+                )
+            if (
+                count >= dev.weak_q
+                and not req.garbage
+                and id(req) not in avail_ids
+            ):
+                out.append(
+                    div("available", "weak-quorum request not in available list")
+                )
+            exp_held = key in crn.my_requests and crn.acks_sent > 0
+            exp_cls = classify_tick_device(
+                False, False, count, exp_held, True, dev.weak_q
+            )
+            if bool(held_arr[slot]) != exp_held or got_cls != exp_cls:
+                out.append(
+                    div(
+                        "tick_class",
+                        f"device class {got_cls} (held {bool(held_arr[slot])})"
+                        f" vs reference {exp_cls} (held {exp_held})",
+                    )
+                )
+        elif flags & SLOW:
+            my_or_weak = bool(crn.my_requests or crn.weak_requests)
+            exp_cls = classify_tick_device(
+                False, True, 0, False, my_or_weak, dev.weak_q
+            )
+            if got_cls != exp_cls:
+                out.append(
+                    div(
+                        "tick_class",
+                        f"device slow class {got_cls} vs reference {exp_cls}",
+                    )
+                )
+
+        weak_keys = set(crn.weak_requests)
+        if not set(crn.strong_requests) <= weak_keys:
+            out.append(div("membership", "strong_requests not subset of weak"))
+        if not weak_keys <= set(crn.requests):
+            out.append(div("membership", "weak_requests not subset of requests"))
     return out
 
 
@@ -171,8 +322,8 @@ class ShadowSampler:
     ``stride``-th frame the slots that frame touched are audited.
     """
 
-    def __init__(self, stride=DEFAULT_STRIDE, registry=None, recorder=None):
-        self.stride = max(1, int(stride))
+    def __init__(self, stride=None, registry=None, recorder=None):
+        self.stride = resolve_stride(stride)
         self.registry = registry
         self.recorder = recorder
         self.frames = 0
@@ -184,13 +335,15 @@ class ShadowSampler:
         self.frames += 1
         if self.frames % self.stride:
             return
-        fast = getattr(tracker, "_fast", None)
-        if fast is None:
+        plane = getattr(tracker, "_fast", None)
+        if plane is None:
+            plane = getattr(tracker, "_device", None)
+        if plane is None:
             return
         slots = set()
         for msg in msgs:
             ack = msg.type
-            slot = fast.slot_of(ack.client_id, ack.req_no)
+            slot = plane.slot_of(ack.client_id, ack.req_no)
             if slot is not None:
                 slots.add(slot)
         if not slots:

@@ -12,6 +12,12 @@ Three production paths:
   across the mesh, each chip running the 256-step verification ladder on
   its shard.
 
+The device-resident client/ack plane (core.device_tracker) builds its
+kernels over the same mesh: its dense per-client state is sharded with
+``client_axis_sharding`` (each chip owns a contiguous block of clients)
+and ack batches are replicated with ``replicated_sharding`` so every
+shard filters the rows it owns.
+
 Shardings are expressed with NamedSharding + explicit shard_map where the
 collective matters; everything compiles identically on a CPU-device mesh
 (tests, dryrun) and a real TPU pod slice.
@@ -58,6 +64,17 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
             )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (AXIS,))
+
+
+def client_axis_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding for per-client dense state: each chip owns a
+    contiguous block of clients (the ack plane's unit of locality)."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated placement (batch columns every shard filters)."""
+    return NamedSharding(mesh, P())
 
 
 def sharded_sha256(mesh: Mesh):

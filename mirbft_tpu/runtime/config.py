@@ -46,6 +46,17 @@ class Config:
     # processor via runtime.build_processor (chaos/live.py, bench.py):
     # serial | pool | tpu | tpu-pool | pipelined | tpu-pipelined.
     processor: str = "serial"
+    # Ack/quorum bookkeeping plane: "host" keeps the numpy _FastAcks
+    # mirror; "device" routes ack frames through the dense jax bitmask
+    # plane (core.device_tracker), falling back to host automatically
+    # when no usable jax backend exists.  None defers to the
+    # MIRBFT_ACK_PLANE env knob (default host).  docs/DEVICE_TRACKER.md.
+    ack_plane: str | None = None
+    # Divergence-oracle audit stride: install a shadow sampler auditing
+    # every Nth ack frame (None leaves hooks.shadow to the embedder; the
+    # MIRBFT_SHADOW_STRIDE env knob overrides the sampler default).
+    # docs/OBSERVABILITY.md#shadow-oracle.
+    shadow_stride: int | None = None
 
     def __post_init__(self):
         if self.logger is None:
@@ -57,3 +68,9 @@ class Config:
             raise ValueError(
                 f"processor must be one of {valid}, got {self.processor!r}"
             )
+        if self.ack_plane not in (None, "host", "device"):
+            raise ValueError(
+                f"ack_plane must be host|device, got {self.ack_plane!r}"
+            )
+        if self.shadow_stride is not None and self.shadow_stride < 1:
+            raise ValueError("shadow_stride must be >= 1")

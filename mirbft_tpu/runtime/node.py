@@ -101,7 +101,22 @@ class Node:
         self._stop_lock = threading.Lock()
         self._stop_done = False
         self._exit_error: BaseException | None = None
-        self._machine = StateMachine(logger=config.logger)
+        self._machine = StateMachine(
+            logger=config.logger, ack_plane=config.ack_plane
+        )
+        if config.shadow_stride is not None and hooks.enabled and (
+            hooks.shadow is None
+        ):
+            # Config-driven divergence oracle: audit every Nth ack frame
+            # (host mirror or device plane) without the embedder having
+            # to install a sampler by hand.
+            from ..obsv.shadow import ShadowSampler
+
+            hooks.shadow = ShadowSampler(
+                stride=config.shadow_stride,
+                registry=hooks.metrics,
+                recorder=hooks.recorder,
+            )
         self._waiters: list[_Waiter] = []
         self._wal_storage = wal_storage
         self._req_storage = req_storage

@@ -1,0 +1,371 @@
+"""Gates for the device-resident ack plane (core/device_tracker.py):
+plane selection (Config / env / clean fallback without a jax backend),
+scalar-reference equivalence of the jitted bitmask kernels, the
+divergence oracle catching an injected device-side bit flip within one
+sampler stride (with a flight-recorder dump), a 10k-client scalar vs
+device parity sweep under a seeded ack storm, and the ack-plane metrics
+both planes emit (docs/DEVICE_TRACKER.md, docs/OBSERVABILITY.md).
+"""
+
+import numpy as np
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.core import device_tracker
+from mirbft_tpu.core.client_tracker import ClientTracker
+from mirbft_tpu.core.msgbuffers import NodeBuffers
+from mirbft_tpu.core.persisted import Persisted
+from mirbft_tpu.core.preimage import host_digest, request_hash_data
+from mirbft_tpu.obsv import hooks, shadow
+from mirbft_tpu.obsv.metrics import ACK_BATCH_BUCKETS, CATALOG, Registry
+from mirbft_tpu.obsv.recorder import FlightRecorder
+from mirbft_tpu.runtime.config import Config
+
+needs_device = pytest.mark.skipif(
+    not device_tracker.device_plane_available(),
+    reason="no usable jax backend",
+)
+
+
+# -- tracker scaffolding (same idiom as test_device_obsv) --------------------
+
+
+def network_state(clients=((7, 100),), n=4, f=1, ci=5):
+    return pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=list(range(n)),
+            f=f,
+            number_of_buckets=n,
+            checkpoint_interval=ci,
+            max_epoch_length=50,
+        ),
+        clients=[
+            pb.NetworkClient(id=cid, width=width, low_watermark=0)
+            for cid, width in clients
+        ],
+    )
+
+
+def make_tracker(state=None, ack_plane=None):
+    persisted = Persisted()
+    persisted.add_c_entry(
+        pb.CEntry(
+            seq_no=0,
+            checkpoint_value=b"genesis",
+            network_state=state if state is not None else network_state(),
+        )
+    )
+    my = pb.InitialParameters(id=0, buffer_size=1 << 20)
+    ct = ClientTracker(persisted, NodeBuffers(my), my, ack_plane=ack_plane)
+    ct.reinitialize()
+    return ct
+
+
+def req(client_id=7, req_no=0, data=b"tx"):
+    r = pb.Request(client_id=client_id, req_no=req_no, data=data)
+    digest = host_digest(request_hash_data(r))
+    return r, pb.RequestAck(client_id=client_id, req_no=req_no, digest=digest)
+
+
+def ack_msg(ack):
+    return pb.Msg(type=ack)
+
+
+def build_device_tracker(n_reqs=40):
+    """Device-plane tracker after a three-source ack storm over reqs
+    0..n_reqs-1 (every slot ends with a strong certificate)."""
+    ct = make_tracker(ack_plane="device")
+    assert ct._device_ok
+    acks = [req(req_no=i)[1] for i in range(n_reqs)]
+    for source in (1, 2, 3):
+        ct.step_ack_many(source, [ack_msg(a) for a in acks])
+    assert ct._device is not None, "device plane never built"
+    assert ct._fast is None, "host mirror must not coexist with the plane"
+    return ct, acks
+
+
+# -- plane selection ----------------------------------------------------------
+
+
+def test_config_validates_ack_plane_and_shadow_stride():
+    Config(id=0, ack_plane="device", shadow_stride=4)  # valid
+    with pytest.raises(ValueError, match="ack_plane"):
+        Config(id=0, ack_plane="gpu")
+    with pytest.raises(ValueError, match="shadow_stride"):
+        Config(id=0, shadow_stride=0)
+
+
+def test_resolve_ack_plane_explicit_env_default(monkeypatch):
+    monkeypatch.delenv("MIRBFT_ACK_PLANE", raising=False)
+    assert device_tracker.resolve_ack_plane() == "host"
+    monkeypatch.setenv("MIRBFT_ACK_PLANE", "device")
+    assert device_tracker.resolve_ack_plane() == "device"
+    # Explicit config beats the env knob.
+    assert device_tracker.resolve_ack_plane("host") == "host"
+    with pytest.raises(ValueError, match="ack_plane"):
+        device_tracker.resolve_ack_plane("tpu")
+    monkeypatch.setenv("MIRBFT_ACK_PLANE", "bogus")
+    with pytest.raises(ValueError, match="ack_plane"):
+        device_tracker.resolve_ack_plane()
+
+
+def test_resolve_stride_explicit_env_default(monkeypatch):
+    monkeypatch.delenv("MIRBFT_SHADOW_STRIDE", raising=False)
+    assert shadow.resolve_stride() == shadow.DEFAULT_STRIDE
+    monkeypatch.setenv("MIRBFT_SHADOW_STRIDE", "3")
+    assert shadow.resolve_stride() == 3
+    assert shadow.resolve_stride(7) == 7  # explicit wins
+    assert shadow.ShadowSampler(stride=5).stride == 5
+
+
+def test_device_plane_falls_back_cleanly_without_backend(monkeypatch):
+    """The tier-1 guard: ack_plane="device" with no usable jax backend
+    (or a plane whose construction dies) must keep full host-path
+    semantics — same quorum state, no divergences, no crash."""
+    monkeypatch.setattr(
+        device_tracker, "device_plane_available", lambda: False
+    )
+    ct = make_tracker(ack_plane="device")
+    assert not ct._device_ok
+    acks = [req(req_no=i)[1] for i in range(40)]
+    for source in (1, 2, 3):
+        ct.step_ack_many(source, [ack_msg(a) for a in acks])
+    assert ct._device is None
+    assert ct._fast is not None  # host columnar mirror took over
+    crn = ct.client(7).req_no(0)
+    assert acks[0].digest in crn.strong_requests
+    assert shadow.audit_tracker(ct) == []
+
+
+def test_device_plane_falls_back_when_construction_raises(monkeypatch):
+    monkeypatch.setattr(
+        device_tracker, "device_plane_available", lambda: True
+    )
+    monkeypatch.setattr(
+        device_tracker,
+        "DeviceClientPlane",
+        type(
+            "Boom",
+            (),
+            {"__init__": lambda self, *a, **k: 1 / 0},
+        ),
+    )
+    ct = make_tracker(ack_plane="device")
+    assert ct._device_ok  # optimistic until the first build attempt
+    acks = [req(req_no=i)[1] for i in range(40)]
+    ct.step_ack_many(1, [ack_msg(a) for a in acks])
+    assert ct._device is None and not ct._device_ok
+    ct.step_ack_many(2, [ack_msg(a) for a in acks])
+    ct.step_ack_many(3, [ack_msg(a) for a in acks])
+    crn = ct.client(7).req_no(0)
+    assert acks[0].digest in crn.strong_requests
+    assert shadow.audit_tracker(ct) == []
+
+
+# -- scalar-reference equivalence --------------------------------------------
+
+
+@needs_device
+def test_device_plane_matches_scalar_reference():
+    ct, acks = build_device_tracker()
+    dev = ct._device
+    assert dev.acks_fallback == 0, "clean storm must not fall back"
+    crn = ct.client(7).req_no(0)
+    assert acks[0].digest in crn.weak_requests
+    assert acks[0].digest in crn.strong_requests
+    assert shadow.audit_tracker(ct) == []
+    certs = dev.quorum_sweep()
+    assert certs == {"weak_certs": 40, "strong_certs": 40, "committed": 0}
+
+
+@needs_device
+def test_conflicting_digest_falls_back_to_scalar_path():
+    """A second distinct digest for an adopted slot cannot be a dense
+    row: the kernel flags it, the scalar reference path absorbs it, and
+    the slot goes host-authoritative with no divergence."""
+    ct, acks = build_device_tracker(n_reqs=4)
+    evil = req(req_no=0, data=b"conflicting")[1]
+    # Source 0 never voted in build_device_tracker, so the scalar spam
+    # guard (one non-null vote per node) does not apply to this row.
+    ct.step_ack_many(0, [ack_msg(evil)])
+    assert ct._device.acks_fallback >= 1
+    crn = ct.client(7).req_no(0)
+    assert evil.digest in crn.requests  # scalar path recorded the vote
+    assert acks[0].digest in crn.strong_requests  # canonical unharmed
+    assert shadow.audit_tracker(ct) == []
+
+
+@needs_device
+def test_committed_slots_drop_acks_on_device():
+    ct, acks = build_device_tracker(n_reqs=4)
+    ct.mark_committed(7, 0, seq_no=1)
+    dropped = ct._device.acks_dropped
+    ct.step_ack_many(1, [ack_msg(acks[0])])
+    assert ct._device.acks_dropped > dropped
+    assert shadow.audit_tracker(ct) == []
+
+
+# -- injected divergence ------------------------------------------------------
+
+
+@needs_device
+def test_injected_device_bitflip_caught_within_stride(tmp_path):
+    """Flip one agreement bit in the device bitmask (a vote the scalar
+    state never saw): the sampling shadow must catch it within one
+    stride of touched frames and dump the flight recorder."""
+    ct, acks = build_device_tracker(n_reqs=8)
+    dev = ct._device
+    # Bit-flip: remove node 3's recorded vote for slot (7, 0) directly
+    # in the device array — popcount drops below the strong quorum while
+    # the object-level strong_requests membership stands.
+    slot = dev.slot_of(7, 0)
+    ci, w = slot // dev.w_pad, slot % dev.w_pad
+    limb = np.uint32(dev._dev[0][ci, w, 0])
+    dev._dev[0] = dev._dev[0].at[ci, w, 0].set(limb & ~np.uint32(1 << 3))
+    dev._snapshot = None
+
+    reg = Registry()
+    rec = FlightRecorder("device-shadow-test", dump_dir=str(tmp_path))
+    sampler = shadow.ShadowSampler(stride=2, registry=reg, recorder=rec)
+    hooks.shadow = sampler
+    try:
+        # Duplicate canonical acks touch the poisoned slot without
+        # mutating it, so the divergence persists until a sampled frame
+        # audits the touched set.
+        frames = 0
+        while not sampler.divergences and frames < 8:
+            ct.step_ack_many(1, [ack_msg(acks[0])])
+            frames += 1
+        assert sampler.divergences, "sampler never saw the bit flip"
+        assert frames <= sampler.stride, "not caught within one stride"
+        comps = {d["component"] for d in sampler.divergences}
+        assert "strong" in comps
+        snap = reg.snapshot()
+        total = sum(
+            s["value"] for s in snap["mirbft_divergence_total"]["series"]
+        )
+        assert total >= 1
+        assert sampler._dumped
+        assert any(tmp_path.iterdir()), "no flight-recorder dump written"
+    finally:
+        hooks.shadow = None
+
+
+# -- 10k-client parity sweep --------------------------------------------------
+
+
+@needs_device
+def test_parity_sweep_10k_clients_under_seeded_ack_storm():
+    """Host plane and device plane absorb the identical seeded ack storm
+    (shuffled frames, duplicates, conflicting digests, out-of-window
+    rows) at 10k clients; sampled slots must agree object-for-object and
+    the oracle must find nothing."""
+    n_clients = 10_000
+    frame = 2048
+    rng = np.random.default_rng(0xD1CE)
+    state = [
+        network_state(clients=tuple((cid, 1) for cid in range(n_clients)))
+        for _ in range(2)
+    ]
+    host = make_tracker(state[0], ack_plane="host")
+    devt = make_tracker(state[1], ack_plane="device")
+    assert devt._device_ok
+
+    digests = {}
+
+    def storm_ack(cid, data=b"tx"):
+        r = pb.Request(client_id=int(cid), req_no=0, data=data)
+        d = digests.get((int(cid), data))
+        if d is None:
+            d = host_digest(request_hash_data(r))
+            digests[(int(cid), data)] = d
+        return pb.RequestAck(client_id=int(cid), req_no=0, digest=d)
+
+    conflicted = set(
+        rng.choice(n_clients, size=100, replace=False).tolist()
+    )
+    for source in (1, 2, 3):
+        order = rng.permutation(n_clients)
+        msgs = []
+        for cid in order.tolist():
+            if source == 3 and cid in conflicted:
+                msgs.append(ack_msg(storm_ack(cid, data=b"fork")))
+            else:
+                msgs.append(ack_msg(storm_ack(cid)))
+        # Sprinkle duplicates and out-of-window rows into every storm.
+        for cid in rng.choice(n_clients, size=64, replace=False).tolist():
+            msgs.append(ack_msg(storm_ack(cid)))
+            msgs.append(
+                pb.Msg(
+                    type=pb.RequestAck(
+                        client_id=int(cid),
+                        req_no=50,
+                        digest=b"\x07" * 32,
+                    )
+                )
+            )
+        for lo in range(0, len(msgs), frame):
+            chunk = msgs[lo : lo + frame]
+            host.step_ack_many(source, chunk)
+            devt.step_ack_many(source, chunk)
+
+    dev = devt._device
+    assert dev is not None
+
+    # Certificate totals from one device pass: every unconflicted client
+    # reached the strong quorum; conflicted slots went host-authoritative
+    # (SLOW) and are excluded from the dense tally by design.
+    certs = dev.quorum_sweep()
+    assert certs["strong_certs"] == n_clients - len(conflicted)
+    assert certs["committed"] == 0
+
+    # Sampled object-level parity: sync pulls the device-authoritative
+    # masks into the objects, then the two trackers must agree exactly.
+    sample = rng.choice(n_clients, size=1500, replace=False)
+    for cid in sample.tolist():
+        dev.sync_slot(cid, 0)
+        h = host.clients[cid].req_no_map[0]
+        d = devt.clients[cid].req_no_map[0]
+        assert set(h.requests) == set(d.requests), cid
+        assert set(h.weak_requests) == set(d.weak_requests), cid
+        assert set(h.strong_requests) == set(d.strong_requests), cid
+        assert h.non_null_voters == d.non_null_voters, cid
+        for digest, hreq in h.requests.items():
+            assert hreq.agreements == d.requests[digest].agreements, cid
+
+    # Oracle sweep over a fresh sample (sync staged the parity sample).
+    audit = rng.choice(n_clients, size=1500, replace=False)
+    slots = [int(c) * dev.w_pad for c in audit.tolist()]
+    assert shadow.audit_tracker(devt, slots=slots) == []
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+@needs_device
+def test_ack_metrics_emitted_from_both_planes():
+    assert "mirbft_ack_events_total" in CATALOG
+    assert "mirbft_ack_batch_size" in CATALOG
+    reg = Registry()
+    hooks.enable(registry=reg)
+    try:
+        acks = [req(req_no=i)[1] for i in range(40)]
+        host = make_tracker(ack_plane="host")
+        host.step_ack_many(1, [ack_msg(a) for a in acks])
+        devt = make_tracker(ack_plane="device")
+        devt.step_ack_many(1, [ack_msg(a) for a in acks])
+        assert devt._device is not None
+    finally:
+        hooks.disable()
+    snap = reg.snapshot()
+    events = {
+        s["labels"]["plane"]: s["value"]
+        for s in snap["mirbft_ack_events_total"]["series"]
+    }
+    assert events == {"host": 40, "device": 40}
+    batches = {
+        s["labels"]["plane"]: s["count"]
+        for s in snap["mirbft_ack_batch_size"]["series"]
+    }
+    assert batches == {"host": 1, "device": 1}
+    assert ACK_BATCH_BUCKETS[0] == 1  # single-ack frames stay observable

@@ -163,6 +163,51 @@ def test_linter_bans_http_server_outside_obsv(tmp_path):
     )
 
 
+def test_linter_confines_core_jax_to_device_tracker(tmp_path):
+    """W16: mirbft_tpu/core/ is pure deterministic Python; jax/jnp
+    imports are confined to core/device_tracker.py, the single
+    sanctioned accelerator boundary of the protocol."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "core" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("import jax\nx = jax\n")
+    findings = lint.check_file(outside)
+    assert any("W16" in line for line in findings), findings
+
+    fromstyle = tmp_path / "mirbft_tpu" / "core" / "sneaky2.py"
+    fromstyle.write_text("import jax.numpy as jnp\nx = jnp\n")
+    assert any("W16" in line for line in lint.check_file(fromstyle))
+
+    fromimport = tmp_path / "mirbft_tpu" / "core" / "sneaky3.py"
+    fromimport.write_text("from jax.sharding import Mesh\nx = Mesh\n")
+    assert any("W16" in line for line in lint.check_file(fromimport))
+
+    # The sanctioned boundary file is exempt — even a tmp copy.
+    allowed = tmp_path / "mirbft_tpu" / "core" / "device_tracker.py"
+    allowed.write_text("import jax\nx = jax\n")
+    assert not any("W16" in line for line in lint.check_file(allowed))
+
+    # The ban is scoped to core/: ops/ kernels import jax freely.
+    elsewhere = tmp_path / "mirbft_tpu" / "ops" / "kernel.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text("import jax\nx = jax\n")
+    assert not any("W16" in line for line in lint.check_file(elsewhere))
+
+    # The real boundary file stays clean against the real rule, and the
+    # purity auditor knows it as a boundary module (D101 stops there
+    # rather than descending into jax internals).
+    assert not any(
+        "W16" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "core" / "device_tracker.py"
+        )
+    )
+    from analysis import rules_d
+
+    assert "mirbft_tpu.core.device_tracker" in rules_d.BOUNDARY_MODULES
+
+
 def test_linter_bans_raw_sockets_outside_transport_and_live(tmp_path):
     """W9: all wire I/O goes through runtime/transport.py or the live
     chaos driver's partition proxies; a raw socket anywhere else in

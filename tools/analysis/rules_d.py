@@ -80,8 +80,18 @@ IMPURE_MODULES: dict[str, str] = {
 # device is the same seam for the kernel layer: ops/ entry points time
 # themselves through it, and the wrapper is a passthrough (one module
 # load and a branch) unless a capture registry is installed.
+# core.device_tracker is the protocol's one sanctioned accelerator
+# boundary (the device-resident ack plane, lint rule W16): the tracker
+# reaches it only behind Config.ack_plane, its kernels are replay-
+# deterministic by contract, and the shadow oracle audits that contract
+# — so traversal stops at its edge rather than dragging jax into the
+# purity proof.
 BOUNDARY_MODULES = frozenset(
-    {"mirbft_tpu.obsv.hooks", "mirbft_tpu.obsv.device"}
+    {
+        "mirbft_tpu.obsv.hooks",
+        "mirbft_tpu.obsv.device",
+        "mirbft_tpu.core.device_tracker",
+    }
 )
 
 # module -> {stdlib top-level name: justification}.  Mirrored in

@@ -1,4 +1,4 @@
-"""General defect classes W1..W15 (the original tools/lint.py checks as
+"""General defect classes W1..W16 (the original tools/lint.py checks as
 Rule objects, message-compatible, plus the seeded-randomness ban and the
 adversary-tooling, resource-introspection, and device-timing
 confinements).
@@ -37,6 +37,12 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   and its instrumentation wrapper.  A stray ``block_until_ready`` in
   protocol code serializes the device pipeline (a silent perf cliff),
   and scattered profiler sessions fight over the single trace backend.
+- W16 ``jax``/``jax.numpy`` imports inside ``mirbft_tpu/core/`` outside
+  ``core/device_tracker.py`` — the protocol state machine is pure
+  deterministic Python (the purity auditor's root set); the device ack
+  plane is its single sanctioned accelerator boundary.  A stray jnp
+  import anywhere else in core/ either drags device nondeterminism into
+  replayed state or silently forces host transfers on the hot path.
 """
 
 from __future__ import annotations
@@ -223,6 +229,17 @@ def in_device_timing_ban_scope(posix: str) -> bool:
         and DEVICE_TIMING_ALLOWED_FILE not in posix
         and DEVICE_TIMING_ALLOWED_TREE not in posix
     )
+
+
+# The single core/ module allowed to import jax: the device-resident ack
+# plane (dense bitmask state + popcount quorum kernels).  Everything else
+# in core/ is the purity auditor's deterministic root set.
+CORE_JAX_ALLOWED_FILE = "mirbft_tpu/core/device_tracker.py"
+
+
+def in_core_jax_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu/core/ files where W16 bans jax imports."""
+    return "mirbft_tpu/core/" in posix and CORE_JAX_ALLOWED_FILE not in posix
 
 
 def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
@@ -678,6 +695,26 @@ def _check_w13(ctx: FileContext):
             )
 
 
+def _check_w16(ctx: FileContext):
+    msg = (
+        "jax import inside mirbft_tpu/core/ outside core/device_tracker.py "
+        "(the protocol state machine is pure deterministic Python; the "
+        "device ack plane is the single sanctioned accelerator boundary)"
+    )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax" or alias.name.startswith("jax."):
+                    yield Finding("W16", ctx.path, node.lineno, msg)
+                    break
+        elif isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            if node.level == 0 and (
+                module == "jax" or module.startswith("jax.")
+            ):
+                yield Finding("W16", ctx.path, node.lineno, msg)
+
+
 def _as_list(gen_fn):
     def check(ctx):
         return list(gen_fn(ctx))
@@ -851,5 +888,18 @@ register(
         ),
         check=_as_list(_check_w15),
         scope=in_device_timing_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W16",
+        title="jax import in core/ outside device_tracker.py",
+        doc=(
+            "mirbft_tpu/core/ is pure deterministic Python; jax/jnp "
+            "imports are confined to core/device_tracker.py, the single "
+            "sanctioned accelerator boundary of the protocol."
+        ),
+        check=_as_list(_check_w16),
+        scope=in_core_jax_ban_scope,
     )
 )
