@@ -1221,6 +1221,7 @@ class ClientTracker:
         my_config: pb.InitialParameters,
         logger=None,
         ack_plane: str | None = None,
+        ack_flush_rows: int | None = None,
     ):
         self.persisted = persisted
         self.node_buffers = node_buffers
@@ -1242,9 +1243,13 @@ class ClientTracker:
         # Config.ack_plane / the MIRBFT_ACK_PLANE env knob, built lazily
         # by step_ack_many like the host mirror, dropped on any
         # window-structure change.
-        from .device_tracker import resolve_ack_plane
+        from .device_tracker import resolve_ack_plane, resolve_flush_rows
 
         self._ack_plane = resolve_ack_plane(ack_plane)
+        # Device-plane frame coalescing (Config.ack_flush_rows /
+        # MIRBFT_ACK_FLUSH_ROWS): kernel flushes defer until this many
+        # ack rows are queued; 1 keeps the flush-per-frame default.
+        self._ack_flush_rows = resolve_flush_rows(ack_flush_rows)
         self._device = None
         self._device_ok = False
 
@@ -1369,6 +1374,11 @@ class ClientTracker:
     def tick(self) -> Actions:
         dev = self._device
         if dev is not None:
+            # Tick boundary: run the kernel over any coalesced frames
+            # and drain the buffered boundary events — the scalar tick
+            # logic below reads _tick_pending and object-side ack state,
+            # both of which deferred flushing leaves behind.
+            dev.flush(drain=self)
             # The scalar tick logic reads and mutates object-side ack
             # state (fetch targeting over agreements, rebroadcast
             # counters): hand every pending slot back to the objects

@@ -78,6 +78,24 @@ def resolve_ack_plane(explicit: str | None = None) -> str:
     return plane
 
 
+def resolve_flush_rows(explicit: int | None = None) -> int:
+    """Resolve the frame-coalescing threshold: the plane defers its
+    kernel flush until at least this many ack rows are queued (1 keeps
+    the synchronous flush-per-frame default).  Explicit config wins,
+    then the ``MIRBFT_ACK_FLUSH_ROWS`` environment knob."""
+    if explicit is None:
+        raw = os.environ.get("MIRBFT_ACK_FLUSH_ROWS", "1")
+        try:
+            explicit = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"ack_flush_rows must be an integer, got {raw!r}"
+            ) from None
+    if explicit < 1:
+        raise ValueError(f"ack_flush_rows must be >= 1, got {explicit}")
+    return explicit
+
+
 def device_plane_available() -> bool:
     """True when jax imports and exposes at least one device.  The
     tracker calls this once per reinitialize; a False (missing jax,
@@ -476,6 +494,14 @@ class DeviceClientPlane:
             weak_q=self.weak_q, strong_q=self.strong_q,
         )
 
+        # The owning tracker: the drain target for flushes forced by
+        # sync points (sync_slot, quorum_sweep) that have no tracker in
+        # their signature.  Same lifetime as the plane itself — the
+        # tracker drops the plane before any window-structure change.
+        self._tracker = tracker
+        # Frame coalescing: apply_frame defers the kernel flush until
+        # this many rows are queued (1 = flush every frame).
+        self.flush_rows = getattr(tracker, "_ack_flush_rows", 1)
         self._staged: dict = {}  # slot -> True (host-authoritative)
         self._snapshot: dict | None = None
         self._pending: list = []  # [(src, ci, w, rno, dig_words, msgs?)]
@@ -554,14 +580,21 @@ class DeviceClientPlane:
     def sync_slot(self, client_id: int, req_no: int) -> None:
         """Hand one slot back to the objects: pull the device masks into
         the owning request/req-no, then mark the slot staged so the next
-        flush re-derives it object→device.  Idempotent until that flush."""
+        flush re-derives it object→device.  Idempotent until that flush.
+
+        Queued batches AND buffered boundary events are drained into the
+        owning tracker first: staging a slot whose adoption/crossing
+        events are still buffered would leave ``canon_req`` unset, so
+        the masks pulled below would land nowhere and the next
+        ``_flush_staged`` would re-derive the row from vote-less objects
+        — silently losing applied acks."""
         slot = self.slot_of(client_id, req_no)
         if slot is None:
             return
         if slot in self._staged:
             return
-        if self._pending_rows:
-            self.flush(drain=None)
+        if self._pending_rows or self._events:
+            self.flush(drain=self._tracker)
         self._staged[slot] = True
         snap = self.host_snapshot()
         if snap["canon_ok"][slot] and not snap["flags"][slot]:
@@ -867,9 +900,14 @@ class DeviceClientPlane:
     # -- tracker entry points ------------------------------------------------
 
     def apply_frame(self, tracker, source: int, msgs: list) -> None:
-        """One inbound ack frame, end to end: columnize, kernel, drain.
-        Out-of-window rows take the tracker's buffering rules (the same
-        verdicts the scalar path reaches)."""
+        """One inbound ack frame: columnize and queue; the kernel flush
+        runs once ``flush_rows`` ack rows are queued (1 = every frame,
+        the default).  Sync points — ``sync_slot`` before any scalar
+        mutation, the tracker's tick boundary, the oracle audits,
+        ``drop`` — force an earlier flush+drain, so coalescing only ever
+        delays materialization, never loses it.  Out-of-window rows take
+        the tracker's buffering rules immediately (the same verdicts the
+        scalar path reaches); they never need the kernel."""
         from .client_tracker import _frame_columns
 
         ids, rnos, dig_mat, irregular = _frame_columns(msgs)
@@ -879,26 +917,37 @@ class DeviceClientPlane:
             # ordering relaxation _step_ack_vector documents).
             keep = np.ones(len(msgs), dtype=bool)
             keep[irregular] = False
+            kept_msgs = [m for i, m in enumerate(msgs) if keep[i]]
             out_rows = self.submit_columns(
                 source, ids[keep], rnos[keep], dig_mat[keep],
-                msgs=[m for i, m in enumerate(msgs) if keep[i]],
+                msgs=kept_msgs,
             )
             tail = [msgs[i] for i in irregular]
         else:
+            kept_msgs = msgs
             out_rows = self.submit_columns(
                 source, ids, rnos, dig_mat, msgs=msgs
             )
             tail = []
-        self.flush(drain=tracker)
+        if self._pending_rows >= self.flush_rows:
+            self.flush(drain=tracker)
+        # out_rows index the SUBMITTED subset, not the original frame:
+        # replay through kept_msgs so a filtered null-digest row can
+        # never misroute a later out-of-window ack onto the wrong
+        # message (node state must not depend on transport framing).
         for r in np.asarray(out_rows).tolist():
-            tracker.step_ack(source, msgs[r])  # buffers / drops per verdict
+            tracker.step_ack(source, kept_msgs[r])  # buffers / drops
         for msg in tail:
             tracker.step_ack(source, msg)
 
     def quorum_sweep(self) -> dict:
         """Tally quorum certificates across every (client, window) bucket
         in one device pass; refreshes the tick_class plane from the same
-        popcounts."""
+        popcounts.  Coalesced frames still in the queue are flushed (and
+        their boundary events drained) first so the tally never lags the
+        ingested acks."""
+        if self._pending_rows or self._events:
+            self.flush(drain=self._tracker)
         self._flush_staged()
         weak, strong, committed, tick = self._sweep(
             self._dev[0], self._dev[3], self._dev[4], self._dev[5],

@@ -41,13 +41,14 @@ class _SMState(enum.Enum):
 
 
 class StateMachine:
-    def __init__(self, logger=None, ack_plane=None):
+    def __init__(self, logger=None, ack_plane=None, ack_flush_rows=None):
         self.logger = logger
         # Ack-plane selection is operational (not consensus state), so it
         # rides here rather than in pb.InitialParameters — the serialized
         # parameter record stays wire-compatible across deployments that
         # mix host- and device-plane nodes.
         self.ack_plane = ack_plane
+        self.ack_flush_rows = ack_flush_rows
         self._state = _SMState.UNINITIALIZED
 
         self.my_config: pb.InitialParameters | None = None
@@ -81,6 +82,7 @@ class StateMachine:
         self.client_tracker = ClientTracker(
             self.persisted, self.node_buffers, parameters, self.logger,
             ack_plane=self.ack_plane,
+            ack_flush_rows=self.ack_flush_rows,
         )
         self.commit_state = CommitState(
             self.persisted, self.client_tracker, self.logger

@@ -52,6 +52,14 @@ class Config:
     # when no usable jax backend exists.  None defers to the
     # MIRBFT_ACK_PLANE env knob (default host).  docs/DEVICE_TRACKER.md.
     ack_plane: str | None = None
+    # Device-plane frame coalescing: defer the ack kernel flush until at
+    # least this many rows are queued (1 = flush every frame).  Sync
+    # points (scalar mutation, tick boundaries, oracle audits) force an
+    # earlier flush+drain, so raising it only trades materialization
+    # latency for amortizing the pow2-padded kernel launch over many
+    # small frames.  None defers to the MIRBFT_ACK_FLUSH_ROWS env knob
+    # (default 1).  docs/DEVICE_TRACKER.md.
+    ack_flush_rows: int | None = None
     # Divergence-oracle audit stride: install a shadow sampler auditing
     # every Nth ack frame (None leaves hooks.shadow to the embedder; the
     # MIRBFT_SHADOW_STRIDE env knob overrides the sampler default).
@@ -74,3 +82,5 @@ class Config:
             )
         if self.shadow_stride is not None and self.shadow_stride < 1:
             raise ValueError("shadow_stride must be >= 1")
+        if self.ack_flush_rows is not None and self.ack_flush_rows < 1:
+            raise ValueError("ack_flush_rows must be >= 1")

@@ -102,7 +102,8 @@ class Node:
         self._stop_done = False
         self._exit_error: BaseException | None = None
         self._machine = StateMachine(
-            logger=config.logger, ack_plane=config.ack_plane
+            logger=config.logger, ack_plane=config.ack_plane,
+            ack_flush_rows=config.ack_flush_rows,
         )
         if config.shadow_stride is not None and hooks.enabled and (
             hooks.shadow is None

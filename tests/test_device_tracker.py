@@ -12,7 +12,7 @@ import pytest
 
 from mirbft_tpu import pb
 from mirbft_tpu.core import device_tracker
-from mirbft_tpu.core.client_tracker import ClientTracker
+from mirbft_tpu.core.client_tracker import _NULL, ClientTracker
 from mirbft_tpu.core.msgbuffers import NodeBuffers
 from mirbft_tpu.core.persisted import Persisted
 from mirbft_tpu.core.preimage import host_digest, request_hash_data
@@ -46,7 +46,7 @@ def network_state(clients=((7, 100),), n=4, f=1, ci=5):
     )
 
 
-def make_tracker(state=None, ack_plane=None):
+def make_tracker(state=None, ack_plane=None, ack_flush_rows=None):
     persisted = Persisted()
     persisted.add_c_entry(
         pb.CEntry(
@@ -56,7 +56,10 @@ def make_tracker(state=None, ack_plane=None):
         )
     )
     my = pb.InitialParameters(id=0, buffer_size=1 << 20)
-    ct = ClientTracker(persisted, NodeBuffers(my), my, ack_plane=ack_plane)
+    ct = ClientTracker(
+        persisted, NodeBuffers(my), my, ack_plane=ack_plane,
+        ack_flush_rows=ack_flush_rows,
+    )
     ct.reinitialize()
     return ct
 
@@ -88,11 +91,13 @@ def build_device_tracker(n_reqs=40):
 
 
 def test_config_validates_ack_plane_and_shadow_stride():
-    Config(id=0, ack_plane="device", shadow_stride=4)  # valid
+    Config(id=0, ack_plane="device", shadow_stride=4, ack_flush_rows=4096)
     with pytest.raises(ValueError, match="ack_plane"):
         Config(id=0, ack_plane="gpu")
     with pytest.raises(ValueError, match="shadow_stride"):
         Config(id=0, shadow_stride=0)
+    with pytest.raises(ValueError, match="ack_flush_rows"):
+        Config(id=0, ack_flush_rows=0)
 
 
 def test_resolve_ack_plane_explicit_env_default(monkeypatch):
@@ -116,6 +121,19 @@ def test_resolve_stride_explicit_env_default(monkeypatch):
     assert shadow.resolve_stride() == 3
     assert shadow.resolve_stride(7) == 7  # explicit wins
     assert shadow.ShadowSampler(stride=5).stride == 5
+
+
+def test_resolve_flush_rows_explicit_env_default(monkeypatch):
+    monkeypatch.delenv("MIRBFT_ACK_FLUSH_ROWS", raising=False)
+    assert device_tracker.resolve_flush_rows() == 1
+    monkeypatch.setenv("MIRBFT_ACK_FLUSH_ROWS", "4096")
+    assert device_tracker.resolve_flush_rows() == 4096
+    assert device_tracker.resolve_flush_rows(8) == 8  # explicit wins
+    with pytest.raises(ValueError, match="ack_flush_rows"):
+        device_tracker.resolve_flush_rows(0)
+    monkeypatch.setenv("MIRBFT_ACK_FLUSH_ROWS", "zap")
+    with pytest.raises(ValueError, match="ack_flush_rows"):
+        device_tracker.resolve_flush_rows()
 
 
 def test_device_plane_falls_back_cleanly_without_backend(monkeypatch):
@@ -202,6 +220,112 @@ def test_committed_slots_drop_acks_on_device():
     dropped = ct._device.acks_dropped
     ct.step_ack_many(1, [ack_msg(acks[0])])
     assert ct._device.acks_dropped > dropped
+    assert shadow.audit_tracker(ct) == []
+
+
+@needs_device
+def test_mixed_null_digest_and_out_of_window_frame():
+    """One frame carrying a null-digest row (filtered out of the dense
+    submit) AND a later out-of-window row: the out-row indices returned
+    by submit_columns refer to the FILTERED subset, so the replay must
+    map them back through it.  Replaying against the original frame
+    double-applies an in-window ack and silently drops the real
+    out-of-window ack — node state depending on transport framing."""
+    ct, acks = build_device_tracker(n_reqs=4)
+    null_ack = pb.RequestAck(client_id=7, req_no=1, digest=b"")
+    oow = pb.RequestAck(client_id=7, req_no=150, digest=b"\x07" * 32)
+    frame = [ack_msg(null_ack), ack_msg(acks[2]), ack_msg(oow)]
+    buf = ct.msg_buffers[0]
+    assert len(buf) == 0
+    ct.step_ack_many(0, frame)
+    # The out-of-window ack is FUTURE: buffered, never dropped.
+    assert [m.type.req_no for m, _ in buf.msgs] == [150]
+    # The null-digest ack took the scalar path into slot (7, 1).
+    crn1 = ct.client(7).req_no(1)
+    assert _NULL in crn1.requests
+    assert crn1.requests[_NULL].agreements & 1  # node 0's vote
+    # The dense row (source 0's vote for the canonical digest of slot
+    # (7, 2)) went through the kernel exactly once.
+    dev = ct._device
+    dev.sync_slot(7, 2)
+    crn2 = ct.client(7).req_no(2)
+    assert crn2.requests[acks[2].digest].agreements == 0b1111
+    assert shadow.audit_tracker(ct) == []
+
+
+@needs_device
+def test_sync_slot_drains_buffered_events_from_column_ingest():
+    """The public submit_columns ingest (the bench's native driver)
+    buffers boundary events when flushed without a drain target;
+    sync_slot must drain queued batches AND those buffered events into
+    the owning tracker before staging the slot, or the next staged
+    re-derivation rebuilds the row from vote-less objects and the
+    applied acks vanish."""
+    ct = make_tracker(ack_plane="device")
+    assert ct._device_ok
+    dev = ct._build_device()
+    assert dev is not None
+    acks = [req(req_no=i)[1] for i in range(4)]
+    ids = np.array([a.client_id for a in acks], dtype=np.int64)
+    rnos = np.array([a.req_no for a in acks], dtype=np.int64)
+    dig_mat = np.frombuffer(
+        b"".join(a.digest for a in acks), dtype=np.uint8
+    ).reshape(len(acks), 32)
+    # Two sources flushed without a drain target, a third left queued.
+    for s in (1, 2):
+        assert len(dev.submit_columns(s, ids, rnos, dig_mat)) == 0
+        dev.flush(drain=None)
+    assert len(dev.submit_columns(3, ids, rnos, dig_mat)) == 0
+    assert dev._events and dev._pending_rows == 4
+    # A host path syncs the slot between submit and drain: the buffered
+    # adoptions/crossings must land in the objects BEFORE the slot goes
+    # host-authoritative.
+    dev.sync_slot(7, 0)
+    assert not dev._events and dev._pending_rows == 0
+    crn = ct.client(7).req_no(0)
+    assert acks[0].digest in crn.requests
+    assert crn.requests[acks[0].digest].agreements == 0b1110
+    assert acks[0].digest in crn.weak_requests
+    assert acks[0].digest in crn.strong_requests
+    assert crn.non_null_voters == 0b1110
+    assert shadow.audit_tracker(ct) == []
+
+
+@needs_device
+def test_small_frame_coalescing_defers_flush_until_sync_points():
+    """ack_flush_rows > 1 coalesces small frames in the pending queue:
+    no kernel launch until the row threshold, with scalar-mutation sync
+    (sync_slot) and the tick boundary forcing an earlier flush+drain so
+    the observable object state stays frame-equivalent."""
+    ct = make_tracker(ack_plane="device", ack_flush_rows=16)
+    acks = [req(req_no=i)[1] for i in range(8)]
+    frame = [ack_msg(a) for a in acks]
+    ct.step_ack_many(1, frame)
+    dev = ct._device
+    assert dev is not None
+    assert dev.flush_rows == 16
+    assert dev.batches == 0 and dev._pending_rows == 8  # deferred
+    crn = ct.client(7).req_no(0)
+    assert acks[0].digest not in crn.requests  # not yet materialized
+    ct.step_ack_many(2, frame)  # 16 rows reach the threshold
+    assert dev.batches == 1 and dev._pending_rows == 0
+    assert acks[0].digest in crn.weak_requests  # events drained at flush
+    ct.step_ack_many(3, frame)  # deferred again (8 < 16)
+    assert dev.batches == 1 and dev._pending_rows == 8
+    assert acks[0].digest not in crn.strong_requests
+    # Scalar mutation forces the sync flush before the slot stages.
+    ct.step_ack(3, ack_msg(acks[0]))
+    assert dev.batches == 2 and dev._pending_rows == 0
+    assert acks[0].digest in crn.strong_requests
+    assert shadow.audit_tracker(ct) == []
+    # The tick boundary flushes whatever is still queued.
+    acks2 = [req(req_no=8 + i)[1] for i in range(4)]
+    ct.step_ack_many(1, [ack_msg(a) for a in acks2])
+    assert dev.batches == 2 and dev._pending_rows == 4
+    ct.tick()
+    assert dev.batches == 3 and dev._pending_rows == 0
+    crn8 = ct.client(7).req_no(8)
+    assert crn8.requests[acks2[0].digest].agreements == 0b0010
     assert shadow.audit_tracker(ct) == []
 
 
