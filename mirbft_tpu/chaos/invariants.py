@@ -301,6 +301,50 @@ def check_flood_bounded(
             )
 
 
+def check_bounded_catchup(
+    join_ms: int, frontier_ms: int | None, bound_ms: int
+) -> None:
+    """A freshly joined (or far-behind) node reached the cluster's commit
+    frontier — via checkpoint-anchored snapshot state transfer — within
+    ``bound_ms`` of its join instant.  ``frontier_ms`` is the wall (or
+    simulated) instant the joiner first held the certified checkpoint
+    state; ``None`` means it never caught up."""
+    if frontier_ms is None:
+        raise InvariantViolation(
+            f"joined node never reached the commit frontier (joined at "
+            f"{join_ms}ms)"
+        )
+    lag = frontier_ms - join_ms
+    if lag > bound_ms:
+        raise InvariantViolation(
+            f"joined node took {lag}ms after joining at {join_ms}ms to "
+            f"reach the commit frontier (bound: {bound_ms}ms)"
+        )
+
+
+def check_transfer_corruption_rejected(
+    rejections: int, corrupted: int
+) -> None:
+    """Snapshot-transfer streams the adversary corrupted/truncated were
+    refused by the fetcher's digest-chain and certificate verification.
+    ``corrupted`` is the proxy manglers' touch count (zero = vacuous),
+    ``rejections`` the engines' ``chunks_rejected_corrupt`` evidence.
+    Mangled frames arriving outside an active fetch are dropped
+    unattributed (stale) rather than rejected-with-evidence, so the
+    audit demands rejection evidence exists rather than exact equality;
+    the none-was-*adopted* half is held by the no-fork / chain-agreement
+    audits, which a single accepted corrupt chunk would break."""
+    if corrupted <= 0:
+        raise InvariantViolation(
+            "transfer-corruption scenario touched no frames (vacuous)"
+        )
+    if rejections <= 0:
+        raise InvariantViolation(
+            f"{corrupted} corrupted transfer frames produced no "
+            "rejection evidence (chunks_rejected_corrupt == 0)"
+        )
+
+
 def check_bounded_recovery(
     completion_ms: int, last_disruption_end_ms: int, bound_ms: int
 ) -> None:

@@ -67,7 +67,14 @@ from ..runtime import (
 )
 from ..runtime.node import NodeStopped, standard_initial_network_state
 from ..runtime.processor import Log
-from ..runtime.transport import _HELLO_SRC, _LEN, TcpTransport, TransportFault
+from ..runtime.transfer import _KIND_CHUNK, TransferEngine
+from ..runtime.transport import (
+    _HELLO_SRC,
+    _LEN,
+    _XFER_SRC,
+    TcpTransport,
+    TransportFault,
+)
 from ..testengine.manglers import _flip_bytes, _variant_digest
 from .invariants import (
     CrashSnapshot,
@@ -79,6 +86,7 @@ from .invariants import (
     check_durable_prefix,
     check_no_fork,
     check_no_fork_under_equivocation,
+    check_transfer_corruption_rejected,
 )
 from ..obsv.recorder import FlightRecorder
 from .runner import (
@@ -280,13 +288,19 @@ class AdversaryProxy(PartitionProxy):
     frames and hands each decoded message to ``mangle(source, msg)``,
     which returns ``None`` (pass through unchanged) or a replacement
     list: ``[]`` censors the frame, a rewritten message corrupts or
-    equivocates it, and extra copies flood the receiver.  Clock-sync
-    hellos and client-proposal frames (reserved source ids) always pass
-    untouched, as does the reverse pump — real peer links are one-way,
-    so only the forward byte stream carries frames."""
+    equivocates it, and extra copies flood the receiver.  Snapshot
+    state-transfer frames (the reserved ``_XFER_SRC`` lane) are opaque
+    bytes, not pb.Msg; they go to ``mangle_transfer(body)`` with the
+    same None / [] / replacement-list contract, so an adversary can
+    corrupt, truncate, or censor a transfer stream in flight.
+    Clock-sync hellos and client-proposal frames (the other reserved
+    source ids) always pass untouched, as does the reverse pump — real
+    peer links are one-way, so only the forward byte stream carries
+    frames."""
 
-    def __init__(self, upstream: tuple, mangle):
+    def __init__(self, upstream: tuple, mangle, mangle_transfer=None):
         self.mangle = mangle
+        self.mangle_transfer = mangle_transfer
         super().__init__(upstream)
 
     def _pump(self, src, dst) -> None:
@@ -294,7 +308,9 @@ class AdversaryProxy(PartitionProxy):
             forward = dst.getpeername() == self.upstream
         except OSError:
             forward = False
-        if not forward or self.mangle is None:
+        if not forward or (
+            self.mangle is None and self.mangle_transfer is None
+        ):
             return super()._pump(src, dst)
         buf = bytearray()
         try:
@@ -326,11 +342,15 @@ class AdversaryProxy(PartitionProxy):
         original = _LEN.pack(len(payload)) + payload
         try:
             source, offset = wire.decode_varint(payload, 0)
+            if source == _XFER_SRC:
+                return self._rewrite_transfer(payload, offset, original)
             if source >= _HELLO_SRC:
                 return original  # hello / client-proposal frame
             msg = pb.decode(pb.Msg, payload[offset:])
         except ValueError:
             return original  # not ours to judge: the receiver drops it
+        if self.mangle is None:
+            return original
         replacement = self.mangle(source, msg)
         if replacement is None:
             return original
@@ -339,6 +359,23 @@ class AdversaryProxy(PartitionProxy):
         for new_msg in replacement:
             body = prefix + pb.encode(new_msg)
             out += _LEN.pack(len(body)) + body
+        return bytes(out)
+
+    def _rewrite_transfer(self, payload, offset, original):
+        """Hand a state-transfer frame body (sender varint preserved, so
+        the fetcher's donor check still attributes it) to the transfer
+        mangler."""
+        if self.mangle_transfer is None:
+            return original
+        _sender, body_start = wire.decode_varint(payload, offset)
+        replacement = self.mangle_transfer(payload[body_start:])
+        if replacement is None:
+            return original
+        prefix = payload[:body_start]
+        out = bytearray()
+        for new_body in replacement:
+            framed = prefix + new_body
+            out += _LEN.pack(len(framed)) + framed
         return bytes(out)
 
 
@@ -437,6 +474,18 @@ class DurableChainLog(Log):
         self._file.close()
 
 
+class _TransportDuct:
+    """TransferEngine's send seam over the real transport's reserved
+    ``_XFER_SRC`` lane (so transfer frames ride the same proxied TCP
+    links — and the same partitions and adversaries — as consensus)."""
+
+    def __init__(self, transport):
+        self.transport = transport
+
+    def send(self, dest: int, body: bytes) -> None:
+        self.transport.send_transfer(dest, body)
+
+
 class LiveReplica:
     """One real node: serializer (inside Node), consumer loop thread,
     TCP transport wired through the cluster's partition proxies, and
@@ -475,13 +524,32 @@ class LiveReplica:
             self.wal,
             self.reqstore,
         )
-        # seq_no -> (value, pb.NetworkState): serves peers' state
-        # transfers out of band (the consumer's job in the reference).
+        # seq_no -> (value, pb.NetworkState): local view of this node's
+        # own stable checkpoints (snapshot material lives in the engine).
         self.checkpoints: dict = {}
         # Pipelined executors hand results to the node internally; the
         # checkpoint capture below must route through their seam.
         if hasattr(self.processor, "on_results"):
             self.processor.on_results = self._capture_checkpoints
+        # Real snapshot state transfer over the transport's reserved
+        # lane; staged under the node dir, so a crash mid-transfer
+        # resumes from the verified staged blob after restart.
+        self.engine = TransferEngine(
+            node_id,
+            _TransportDuct(self.transport),
+            staging_dir=self.dir,
+            peers=[
+                p
+                for p in range(cluster.scenario.node_count)
+                if p != node_id
+            ],
+            limits=config,
+            install=self._install_snapshot,
+            complete=self.node.state_transfer_complete,
+            failed=self.node.state_transfer_failed,
+            chunk_timeout_s=max(cluster.tick_seconds * 10, 0.5),
+        )
+        self.transport.set_transfer_sink(self.engine.on_frame)
         self.failed = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -527,14 +595,39 @@ class LiveReplica:
 
     def _capture_checkpoints(self, results) -> None:
         for cr in results.checkpoints:
-            self.checkpoints[cr.checkpoint.seq_no] = (
-                cr.value,
-                pb.NetworkState(
-                    config=cr.checkpoint.network_config,
-                    clients=cr.checkpoint.clients_state,
-                    pending_reconfigurations=list(cr.reconfigurations),
-                ),
+            network_state = pb.NetworkState(
+                config=cr.checkpoint.network_config,
+                clients=cr.checkpoint.clients_state,
+                pending_reconfigurations=list(cr.reconfigurations),
             )
+            self.checkpoints[cr.checkpoint.seq_no] = (cr.value, network_state)
+            requests: list = []
+
+            def _collect(ack, _data=None):
+                # FileRequestStore.uncommitted hands only the ack; the
+                # payload is a separate read.
+                data = self.reqstore.get(ack)
+                if data is not None:
+                    requests.append((ack, data))
+
+            self.reqstore.uncommitted(_collect)
+            self.engine.note_checkpoint(
+                cr.checkpoint.seq_no,
+                cr.value,
+                network_state,
+                self.app_log.chain,
+                requests,
+            )
+
+    def _install_snapshot(self, snap):
+        """TransferEngine install callback: adopt the app chain (an
+        fsynced adopt record) and the donor's uncommitted-request slice,
+        then let the node persist the checkpoint CEntry."""
+        self.app_log.adopt(snap.value, snap.seq_no)
+        for ack, data in snap.requests:
+            self.reqstore.store(ack, data)
+        self.reqstore.sync()
+        return snap.network_state
 
     def _consume(self) -> None:
         tick_seconds = self.cluster.tick_seconds
@@ -552,24 +645,12 @@ class LiveReplica:
                     last_tick = now
                     self.node.tick()
                 if actions is not None and actions.state_transfer is not None:
-                    self._serve_transfer(actions.state_transfer)
+                    self.engine.begin(actions.state_transfer)
+                self.engine.poll()
         except NodeStopped:
             pass
         except Exception as err:  # noqa: BLE001 — injected faults land here
             self.failed = err
-
-    def _serve_transfer(self, target) -> None:
-        for peer in self.cluster.alive_replicas():
-            if peer is self:
-                continue
-            entry = peer.checkpoints.get(target.seq_no)
-            if entry is None or entry[0] != target.value:
-                continue
-            value, network_state = entry
-            self.app_log.adopt(value, target.seq_no)
-            self.node.state_transfer_complete(target, network_state)
-            return
-        self.node.state_transfer_failed(target)
 
     def snapshot(self, at_ms: int) -> CrashSnapshot:
         return CrashSnapshot(
@@ -628,6 +709,8 @@ class _LiveAdversary:
         self.rejections = 0
         self.flooded = 0
         self.censored = 0
+        self.corrupted_transfer = 0
+        self.censored_transfer = 0
         self.censored_pairs: set = set()
         self.variants: dict = {}
         self.from_s = cluster.scale_s(spec.from_ms)
@@ -657,9 +740,60 @@ class _LiveAdversary:
     def wire_kind_matches(self, msg: pb.Msg) -> bool:
         return type(msg.type).__name__ in self.spec.msg_kinds
 
+    def attacks_transfer(self) -> bool:
+        """Is this spec a snapshot state-transfer stream attack?  The
+        DSL names the surface ``msg_kinds=("SnapshotChunk",)`` — not a
+        pb wire type, so the pb-frame manglers never match it."""
+        return "SnapshotChunk" in self.spec.msg_kinds and self.spec.kind in (
+            "corrupt",
+            "censor",
+        )
+
+    def applies_to_transfer_edge(self, a: int, b: int) -> bool:
+        """Does this adversary attack transfer frames on edge a -> b?
+        ``node`` scopes the compromised sender/link (-1 = any edge);
+        ``victims`` optionally restricts the fetching side."""
+        if not self.attacks_transfer():
+            return False
+        spec = self.spec
+        if spec.victims and b not in spec.victims:
+            return False
+        return spec.node < 0 or spec.node == a
+
+    def mangle_transfer(self, body: bytes):
+        """Apply this adversary to one transfer frame body; returns None
+        (untouched) or the replacement list.  Only CHUNK frames are
+        attacked — they carry the snapshot bytes whose digest chain the
+        fetcher must hold against exactly this adversary."""
+        if not self.active() or not self.fires():
+            return None
+        try:
+            kind, _pos = wire.decode_varint(body, 0)
+        except ValueError:
+            return None
+        if kind != _KIND_CHUNK:
+            return None
+        if self.spec.kind == "censor":
+            with self._lock:
+                self.censored_transfer += 1
+            return []
+        # Corrupt: alternate bit-flips with tail truncation, both of
+        # which the fetcher's chained digests must catch.
+        with self._lock:
+            truncate = len(body) > 2 and self._rng.random() < 0.5
+        if truncate:
+            mutated = body[: max(1, len(body) // 2)]
+        else:
+            mutated = self.flip(body)
+        with self._lock:
+            self.corrupted_transfer += 1
+        return [mutated]
+
     def applies_to_edge(self, a: int, b: int) -> bool:
         """Does this adversary attack frames on directed edge a -> b?"""
         spec = self.spec
+        if self.attacks_transfer():
+            return False  # transfer-lane attack, not a pb wire attack
         if spec.kind == "equivocate":
             return spec.node == a and b in spec.victims
         if spec.kind == "censor":
@@ -818,7 +952,7 @@ class LiveCluster:
         self._censors = [
             adv
             for adv in self.live_adversaries
-            if adv.spec.kind == "censor"
+            if adv.spec.kind == "censor" and not adv.attacks_transfer()
         ]
         self._propose_corrupters = [
             adv
@@ -895,9 +1029,10 @@ class LiveCluster:
                 if a != b:
                     upstream = self.replicas[b].transport.address
                     mangle = self._edge_mangler(a, b)
+                    mangle_transfer = self._edge_transfer_mangler(a, b)
                     self.proxies[(a, b)] = (
-                        AdversaryProxy(upstream, mangle)
-                        if mangle is not None
+                        AdversaryProxy(upstream, mangle, mangle_transfer)
+                        if mangle is not None or mangle_transfer is not None
                         else PartitionProxy(upstream)
                     )
         for replica in self.replicas:
@@ -932,6 +1067,34 @@ class LiveCluster:
             return frames if changed else None
 
         return mangle
+
+    def _edge_transfer_mangler(self, a: int, b: int):
+        """Compose the snapshot-transfer-stream adversaries for directed
+        edge a -> b into one body-mangle callback, or None."""
+        advs = [
+            adv
+            for adv in self.live_adversaries
+            if adv.applies_to_transfer_edge(a, b)
+        ]
+        if not advs:
+            return None
+
+        def mangle_transfer(body: bytes):
+            bodies = [body]
+            changed = False
+            for adv in advs:
+                next_bodies = []
+                for item in bodies:
+                    replacement = adv.mangle_transfer(item)
+                    if replacement is None:
+                        next_bodies.append(item)
+                    else:
+                        changed = True
+                        next_bodies.extend(replacement)
+                bodies = next_bodies
+            return bodies if changed else None
+
+        return mangle_transfer
 
     def _edges_across(self, groups):
         group_of = {}
@@ -1389,6 +1552,22 @@ def _audit_live_adversaries(scenario, cluster, registry, result) -> None:
         )
         for rotation in rotations:
             histogram.observe(rotation)
+    if any(adv.attacks_transfer() for adv in advs):
+        transfer_corrupted = sum(adv.corrupted_transfer for adv in advs)
+        transfer_censored = sum(adv.censored_transfer for adv in advs)
+        result.counters["transfer_corrupted"] = transfer_corrupted
+        result.counters["transfer_censored"] = transfer_censored
+        rejected = sum(
+            replica.engine.counters["chunks_rejected_corrupt"]
+            for replica in cluster.alive_replicas()
+        )
+        result.counters["transfer_rejected"] = rejected
+        if transfer_corrupted:
+            check_transfer_corruption_rejected(rejected, transfer_corrupted)
+        elif transfer_censored <= 0:
+            raise InvariantViolation(
+                "transfer attack touched no frames (vacuous)"
+            )
     if any(adv.spec.kind == "flood" for adv in advs):
         result.counters["flooded"] = flooded
         if flooded <= 0:

@@ -67,6 +67,31 @@ class PartitionWindow:
 
 
 @dataclass(frozen=True)
+class NodeJoin:
+    """Reconfiguration under fire (mp driver only): ``node`` is a
+    provisioned member of the network config that is *not* booted at
+    cluster start — the running subset carries the bootstrap leader set,
+    so the absent member owns no buckets.  At ``at_ms`` the supervisor
+    spawns it fresh (``join_node``) against the running cluster; it must
+    reach the commit frontier via checkpoint-anchored snapshot state
+    transfer within ``catchup_bound_ms`` (``check_bounded_catchup``)."""
+
+    at_ms: int
+    node: int
+    catchup_bound_ms: int = 60_000
+
+
+@dataclass(frozen=True)
+class NodeRemoval:
+    """Reconfiguration under fire (mp driver only): at ``at_ms`` the
+    node is permanently removed — SIGKILL with no restart — and the
+    survivors must keep committing (quorums permitting)."""
+
+    at_ms: int
+    node: int
+
+
+@dataclass(frozen=True)
 class StorageFault:
     """Live-only fault: from ``at_ms`` the node's WAL/reqstore fsyncs
     raise OSError, so the runtime's persist path fails loudly; the
@@ -93,6 +118,10 @@ class Adversary:
       in flight.  ``msg_kinds=("Propose",)`` attacks client proposals
       (signed mode must reject 100%); other kinds name wire messages.
       ``victims`` restricts to deliveries into those nodes (empty = all).
+      ``msg_kinds=("SnapshotChunk",)`` attacks the snapshot
+      state-transfer stream instead (live/mp drivers only): chunk frames
+      are bit-flipped or tail-truncated (``corrupt``) or dropped
+      (``censor``), and the fetcher's digest chain must reject 100%.
     * ``equivocate`` — ``node`` (a leader) sends conflicting Preprepares
       for the same (epoch, seq) to the ``victims`` follower subset.
     * ``censor`` — ``node`` silently drops every event speaking for the
@@ -167,15 +196,20 @@ def _rotating_network_state(
     node_count: int = 4,
     client_ids: tuple = (4, 5),
     max_epoch_length: int = 40,
+    checkpoint_interval: int | None = None,
 ):
     """Factory for a network state with a short planned epoch length, so
     graceful bucket rotation — the paper's anti-censorship defense —
     happens within a scenario run instead of after the default 10
-    checkpoint windows."""
+    checkpoint windows.  ``checkpoint_interval`` additionally shrinks
+    the watermark window, which is how state-transfer scenarios make a
+    rebooted node fall a full certified checkpoint behind quickly."""
 
     def build():
         state = standard_initial_network_state(node_count, list(client_ids))
         state.config.max_epoch_length = max_epoch_length
+        if checkpoint_interval:
+            state.config.checkpoint_interval = checkpoint_interval
         return state
 
     return build
@@ -198,6 +232,8 @@ class Scenario:
     partitions: tuple = ()  # PartitionWindows (both engines)
     drop_pct: int = 0  # uniform message-loss percentage (both engines)
     storage_faults: tuple = ()  # StorageFaults (live driver only)
+    joins: tuple = ()  # NodeJoins (mp driver only)
+    removes: tuple = ()  # NodeRemovals (mp driver only)
     # Signed-request mode: clients Ed25519-sign, replicas verify at
     # ingress through a SignaturePlane (factory below, fresh per run).
     signed: bool = False
@@ -226,6 +262,8 @@ class Scenario:
         ends.extend(w.until_ms for w in self.partitions)
         ends.extend(c.at_ms + c.restart_delay_ms for c in self.crashes)
         ends.extend(s.at_ms + s.restart_delay_ms for s in self.storage_faults)
+        ends.extend(j.at_ms for j in self.joins)
+        ends.extend(r.at_ms for r in self.removes)
         return ends
 
     def build_manglers(self) -> list:
@@ -708,6 +746,41 @@ LIVE_ADVERSARY_NAMES = (
 )
 
 
+def transfer_corrupt_scenario() -> Scenario:
+    """Live-only (the snapshot transfer lane exists on the real
+    transport, not in the deterministic engine): a rebooted straggler
+    must catch up by state transfer while its deterministic first donor
+    (node 0 — the fetcher walks its peer list in order) corrupts every
+    chunk it serves.  The digest chain must reject 100% of the
+    corruption with counter evidence, and the fetch must fail over to
+    an honest donor and still install a certified snapshot."""
+    return Scenario(
+        name="transfer-corrupt-stream",
+        description=(
+            "node 2 reboots far behind a fast-checkpointing cluster; "
+            "every snapshot chunk its first donor sends is bit-flipped "
+            "or truncated in flight — the digest chain rejects all of "
+            "it and the fetch fails over to an honest donor"
+        ),
+        reqs_per_client=20,
+        crashes=(CrashPoint(at_ms=2000, node=2, restart_delay_ms=6000),),
+        adversaries=(
+            Adversary(
+                kind="corrupt",
+                node=0,
+                victims=(2,),
+                msg_kinds=("SnapshotChunk",),
+            ),
+        ),
+        network_state=_rotating_network_state(
+            max_epoch_length=60, checkpoint_interval=6
+        ),
+        tags=("adversary", "transfer", "live"),
+    )
+
+
 def live_adversary_matrix() -> list:
     by_name = {s.name: s for s in matrix()}
-    return [by_name[name] for name in LIVE_ADVERSARY_NAMES]
+    return [by_name[name] for name in LIVE_ADVERSARY_NAMES] + [
+        transfer_corrupt_scenario()
+    ]

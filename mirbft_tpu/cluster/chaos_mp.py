@@ -29,6 +29,7 @@ rejected rather than silently weakened.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -38,6 +39,7 @@ from .. import pb
 from ..chaos.invariants import (
     CrashSnapshot,
     InvariantViolation,
+    check_bounded_catchup,
     check_bounded_recovery,
     check_commit_resumption,
     check_durable_prefix,
@@ -45,8 +47,15 @@ from ..chaos.invariants import (
 )
 from ..chaos.live import MIN_RECOVERY_BOUND_MS, SIM_TICK_MS
 from ..chaos.runner import CampaignResult, ScenarioResult
-from ..chaos.scenarios import Scenario, live_smoke_matrix
+from ..chaos.scenarios import (
+    NodeJoin,
+    NodeRemoval,
+    PartitionWindow,
+    Scenario,
+    live_smoke_matrix,
+)
 from .supervisor import ClusterSupervisor
+from .worker import read_json
 
 # The mp acceptance pair: a true kill -9 + restart-from-disk, and a
 # proxied minority partition with heal — plus the dedup storm.
@@ -69,12 +78,88 @@ def retry_storm_scenario() -> Scenario:
     )
 
 
+def join_under_partition_scenario() -> Scenario:
+    """Reconfiguration under fire, the add-node half: a 5th provisioned
+    member is spawned against the running 4-node cluster mid-run, and a
+    partition then strands it with only part of the mesh while it is
+    still catching up.  The joiner holds no log — the only way it can
+    reach the commit frontier is a real snapshot fetch over the
+    transport's transfer lane, verified against a 2f+1 checkpoint
+    certificate.  The audit demands exactly that: bounded catch-up AND
+    ``snapshots_installed >= 1`` in the joiner's published engine
+    counters, so live replay can never quietly stand in for transfer."""
+    return Scenario(
+        name="join-under-partition",
+        description=(
+            "5th member joins a running cluster mid-traffic, then a "
+            "partition strands it with a minority; it must fetch a "
+            "certified snapshot over the real transport and reach the "
+            "frontier within the catch-up bound"
+        ),
+        node_count=5,
+        client_count=2,
+        reqs_per_client=6,
+        joins=(NodeJoin(at_ms=4000, node=4, catchup_bound_ms=150_000),),
+        # Sim-ms scale to wall: x * tick/500.  The cut lands well after
+        # the (blocking, process-spawn) join returns, mid catch-up; the
+        # heal leaves the survivors a full traffic tail to converge on.
+        partitions=(
+            PartitionWindow(
+                groups=((0, 1, 4), (2, 3)),
+                from_ms=31_250,
+                until_ms=68_750,
+            ),
+        ),
+        # Shrink the checkpoint window so the joiner falls a certified
+        # checkpoint behind quickly (identical in every worker spec, so
+        # fresh boots stay deterministic).
+        notes={"checkpoint_interval": 5},
+        tags=("mp", "reconfig"),
+    )
+
+
+def remove_under_partition_scenario() -> Scenario:
+    """The remove-node half: node 3 is first partitioned away, then
+    permanently removed (true kill -9, never restarted) while the
+    majority side keeps committing.  The survivors must converge, and
+    the corpse's durable log must remain a clean prefix of theirs."""
+    return Scenario(
+        name="remove-under-partition",
+        description=(
+            "node 3 is isolated, then permanently removed mid-window; "
+            "the 3-node majority keeps committing and the removed "
+            "node's durable log stays a clean prefix"
+        ),
+        node_count=4,
+        client_count=2,
+        reqs_per_client=6,
+        partitions=(
+            PartitionWindow(
+                groups=((0, 1, 2), (3,)), from_ms=12_500, until_ms=50_000
+            ),
+        ),
+        removes=(NodeRemoval(at_ms=25_000, node=3),),
+        tags=("mp", "reconfig"),
+    )
+
+
+MP_RECONFIG_NAMES = ("join-under-partition", "remove-under-partition")
+
+
+def mp_reconfig_matrix() -> list:
+    """The reconfiguration-under-fire pair (mp-only: joining means
+    spawning a real OS process against a live mesh)."""
+    return [join_under_partition_scenario(), remove_under_partition_scenario()]
+
+
 def mp_matrix() -> list:
     """Scenarios run under ``chaos --live --cluster mp``."""
     by_name = {s.name: s for s in live_smoke_matrix()}
-    return [by_name[name] for name in MP_SMOKE_NAMES] + [
-        retry_storm_scenario()
-    ]
+    return (
+        [by_name[name] for name in MP_SMOKE_NAMES]
+        + [retry_storm_scenario()]
+        + mp_reconfig_matrix()
+    )
 
 
 def mp_adversary_matrix() -> list:
@@ -151,6 +236,8 @@ class _MpDriver:
             processor=processor,
             tick_seconds=tick_seconds,
             proxied=bool(scenario.partitions),
+            deferred_nodes=tuple(j.node for j in scenario.joins),
+            checkpoint_interval=scenario.notes.get("checkpoint_interval"),
         )
         self.expected = {
             (client_id, req_no)
@@ -163,6 +250,10 @@ class _MpDriver:
         self.storm_repeat = 3 if scenario.name == "retry-storm-dedup" else 1
         self._start = None
         self.down: set = set()  # crashed, restart still pending
+        self.removed: set = set()  # permanently removed, never restarted
+        self.pending_joins: set = {j.node for j in scenario.joins}
+        self.join_times_ms: dict = {}  # node -> wall ms the join fired
+        self.catchup_times_ms: dict = {}  # node -> first frontier evidence
         self.snapshots: list = []
         self.commit_times_ms: list = []
         self.heal_times_ms: list = []
@@ -259,6 +350,12 @@ class _MpDriver:
                     point.node,
                 )
             )
+        for join in self.scenario.joins:
+            events.append((self.scale_s(join.at_ms), 4, "join", join.node))
+        for removal in self.scenario.removes:
+            events.append(
+                (self.scale_s(removal.at_ms), 5, "remove", removal.node)
+            )
         events.sort(key=lambda e: (e[0], e[1]))
         return events
 
@@ -283,11 +380,44 @@ class _MpDriver:
             self.supervisor.restart(payload)
             self.down.discard(payload)
             self.heal_times_ms.append(self.now_ms())
+        elif kind == "join":
+            self.supervisor.join_node(payload)
+            self.join_times_ms[payload] = self.now_ms()
+            # Joining is a disruption end: catch-up traffic starts here.
+            self.heal_times_ms.append(self.now_ms())
+        elif kind == "remove":
+            self.supervisor.poll_commits()
+            self.snapshots.append(
+                CrashSnapshot(
+                    node=payload,
+                    at_ms=self.now_ms(),
+                    committed=list(self.supervisor.nodes[payload].commits),
+                )
+            )
+            self.removed.add(payload)
+            self.supervisor.kill(payload, graceful=False)
+            # Removal is permanent; the survivors' recovery clock starts
+            # at the removal instant.
+            self.heal_times_ms.append(self.now_ms())
+
+    def _observe_catchup(self) -> None:
+        """First non-empty app-chain on a joined node = it adopted the
+        certified snapshot (or applied its first live batch) — the
+        bounded-catchup clock's stop instant."""
+        for node, _joined in self.join_times_ms.items():
+            if node in self.catchup_times_ms:
+                continue
+            if self.supervisor.nodes[node].chain:
+                self.catchup_times_ms[node] = self.now_ms()
 
     def _reap(self) -> None:
         for handle in self.supervisor.nodes:
-            if handle.node_id in self.down:
+            if handle.node_id in self.down or handle.node_id in self.removed:
                 continue
+            if handle.node_id in self.pending_joins and (
+                handle.node_id not in self.join_times_ms
+            ):
+                continue  # deferred member not spawned yet
             if handle.process is not None and not handle.alive:
                 raise InvariantViolation(
                     f"node {handle.node_id} process died without an "
@@ -301,6 +431,8 @@ class _MpDriver:
         full = False
         chains = set()
         for handle in self.supervisor.nodes:
+            if handle.node_id in self.removed:
+                continue  # permanently gone; survivors carry the audit
             if not handle.alive:
                 return False
             pairs = {(c, q) for c, q, _s in handle.commits}
@@ -332,6 +464,8 @@ class _MpDriver:
                 self._fire(kind, payload)
             if self.supervisor.poll_commits():
                 self.commit_times_ms.append(self.now_ms())
+            if self.join_times_ms:
+                self._observe_catchup()
             self._reap()
             if not events and self._converged():
                 return self.now_ms()
@@ -351,11 +485,39 @@ class _MpDriver:
                 SimpleNamespace(
                     committed_reqs=list(handle.commits),
                     app_chain=handle.chain,
-                    crashed=False,
+                    crashed=handle.node_id in self.removed,
                 )
                 for handle in self.supervisor.nodes
             ],
         )
+
+    def transfer_counters(self, node: int) -> dict:
+        """The engine evidence a worker last published to its
+        transfer.json (empty when the file never appeared)."""
+        doc = read_json(
+            os.path.join(self.supervisor.nodes[node].dir, "transfer.json")
+        )
+        if not doc:
+            return {}
+        counters = doc.get("counters", {})
+        return counters if isinstance(counters, dict) else {}
+
+    def wait_transfer_evidence(self, node: int, timeout_s: float = 3.0) -> dict:
+        """Counters once they show an installed/resumed snapshot, or the
+        last observation after ``timeout_s``.  Workers publish on a 0.5s
+        cadence, so convergence (detected from the fsynced app.log) can
+        race a hair ahead of the final counter publish."""
+        deadline = time.monotonic() + timeout_s
+        counters = self.transfer_counters(node)
+        while time.monotonic() < deadline:
+            installed = int(counters.get("snapshots_installed", 0)) + int(
+                counters.get("snapshots_resumed_staged", 0)
+            )
+            if installed >= 1:
+                break
+            time.sleep(0.05)
+            counters = self.transfer_counters(node)
+        return counters
 
     def teardown(self) -> None:
         self._proposer_stop.set()
@@ -403,6 +565,39 @@ def run_mp_scenario(
             evidence = driver.evidence()
             check_no_fork(evidence)
             check_durable_prefix(evidence, driver.snapshots)
+            for join in scenario.joins:
+                joined_ms = driver.join_times_ms.get(join.node)
+                if joined_ms is None:
+                    raise InvariantViolation(
+                        f"join of node {join.node} never fired inside "
+                        "the run window"
+                    )
+                caught_ms = driver.catchup_times_ms.get(join.node)
+                catchup_bound = max(
+                    int(driver.scale_s(join.catchup_bound_ms) * 1000),
+                    MIN_RECOVERY_BOUND_MS,
+                )
+                if caught_ms is not None:
+                    result.counters["catchup_ms"] = caught_ms - joined_ms
+                check_bounded_catchup(joined_ms, caught_ms, catchup_bound)
+                # The joiner must have reached the frontier by *state
+                # transfer*, not by quietly replaying live traffic — a
+                # fresh process that joined mid-run has no log to replay,
+                # so zero installed snapshots means the scenario proved
+                # nothing about the transfer path.
+                counters = driver.wait_transfer_evidence(join.node)
+                installed = int(
+                    counters.get("snapshots_installed", 0)
+                ) + int(counters.get("snapshots_resumed_staged", 0))
+                result.counters["snapshots_installed"] = installed
+                if installed <= 0:
+                    raise InvariantViolation(
+                        f"joined node {join.node} reached the frontier "
+                        "without installing a snapshot (vacuous join "
+                        f"scenario; engine counters: {counters})"
+                    )
+            if scenario.removes:
+                result.counters["removed"] = len(scenario.removes)
             if driver.flood_specs:
                 result.counters["flooded"] = driver.flooded
                 if driver.flooded <= 0:

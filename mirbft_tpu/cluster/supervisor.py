@@ -115,6 +115,8 @@ class ClusterSupervisor:
         tick_seconds: float = 0.04,
         proxied: bool = False,
         keep_root: bool = False,
+        deferred_nodes=(),
+        checkpoint_interval: int | None = None,
     ):
         if profile not in WAN_PROFILES:
             raise ValueError(
@@ -147,6 +149,27 @@ class ClusterSupervisor:
             for n in range(node_count)
         ]
         self.proxies: dict = {}  # (src, dst) -> PartitionProxy
+        # Reconfiguration under fire: ``deferred_nodes`` are provisioned
+        # members of the network config that start() does NOT spawn.
+        # Every fresh worker then boots with the running subset as its
+        # bootstrap leader set (identical FEntry everywhere), so the
+        # absent members own no buckets until join_node() spawns them.
+        self.deferred: set = set(int(n) for n in deferred_nodes)
+        if self.deferred - set(range(node_count)):
+            raise ValueError("deferred_nodes outside the provisioned set")
+        if self.deferred:
+            quorum = node_count - (node_count - 1) // 3
+            if len(self.deferred) > node_count - quorum:
+                raise ValueError(
+                    "deferring that many nodes leaves no boot quorum"
+                )
+        self._boot_leaders = (
+            sorted(set(range(node_count)) - self.deferred)
+            if self.deferred
+            else None
+        )
+        self.checkpoint_interval = checkpoint_interval
+        self._booted: set = set()  # ids with a known transport address
         # Guards the client transport handle: submit() runs on load
         # generator threads while teardown() runs on the driver thread,
         # and an unguarded check-then-use would race the close-and-None.
@@ -162,7 +185,7 @@ class ClusterSupervisor:
             for peer, link in self.latency.items()
             if int(peer) != node_id
         }
-        return {
+        spec = {
             "node_id": node_id,
             "node_count": self.node_count,
             "client_ids": self.client_ids,
@@ -176,6 +199,14 @@ class ClusterSupervisor:
             "latency": latency,
             "latency_seed": self.latency_seed,
         }
+        if self._boot_leaders is not None:
+            # Every fresh worker (including a later joiner) builds the
+            # same bootstrap FEntry, so the deterministic initial state
+            # matches across the whole provisioned member set.
+            spec["initial_leaders"] = self._boot_leaders
+        if self.checkpoint_interval is not None:
+            spec["checkpoint_interval"] = int(self.checkpoint_interval)
+        return spec
 
     def _spawn(self, handle: _NodeHandle) -> None:
         # A stale address.json would satisfy the boot wait instantly;
@@ -262,9 +293,12 @@ class ClusterSupervisor:
         return ("127.0.0.1", self.nodes[dst].transport_port)
 
     def _publish_peers(self, node_id: int) -> None:
+        # Only peers with a known address (deferred members appear once
+        # join_node boots them; workers re-poll peers.json and dial the
+        # newcomers).
         peers = {
             str(peer): list(self._peer_address(node_id, peer))
-            for peer in range(self.node_count)
+            for peer in sorted(self._booted)
             if peer != node_id
         }
         write_json_atomic(
@@ -272,31 +306,36 @@ class ClusterSupervisor:
             {"peers": peers},
         )
 
+    def _boot_handles(self) -> list:
+        return [h for h in self.nodes if h.node_id not in self.deferred]
+
     def start(self, timeout_s: float = 120.0) -> None:
-        """Boot the full cluster and block until every node is ready."""
+        """Boot the cluster (minus deferred members) and block until
+        every spawned node is ready."""
         if self._started:
             raise RuntimeError("cluster already started")
         self._started = True
         deadline = time.monotonic() + timeout_s
-        for handle in self.nodes:
+        for handle in self._boot_handles():
             os.makedirs(handle.dir, exist_ok=True)
             write_json_atomic(
                 handle.spec_path,
                 self._spec(handle.node_id, fresh=True, transport_port=0),
             )
             self._spawn(handle)
-        for handle in self.nodes:
+        for handle in self._boot_handles():
             self._wait_address(handle, deadline)
+            self._booted.add(handle.node_id)
         if self.proxied:
-            for a in range(self.node_count):
-                for b in range(self.node_count):
+            for a in sorted(self._booted):
+                for b in sorted(self._booted):
                     if a != b:
                         self.proxies[(a, b)] = PartitionProxy(
                             ("127.0.0.1", self.nodes[b].transport_port)
                         )
-        for handle in self.nodes:
+        for handle in self._boot_handles():
             self._publish_peers(handle.node_id)
-        for handle in self.nodes:
+        for handle in self._boot_handles():
             self._wait_ready(handle, deadline)
         client_transport = TcpTransport(
             _CLIENT_NODE_ID,
@@ -305,12 +344,55 @@ class ClusterSupervisor:
             backoff_cap=0.25,
             dial_timeout=1.0,
         )
-        for handle in self.nodes:
+        for handle in self._boot_handles():
             client_transport.connect(
                 handle.node_id, ("127.0.0.1", handle.transport_port)
             )
         with self._lock:
             self._client_transport = client_transport
+
+    def join_node(self, node_id: int, timeout_s: float = 60.0) -> None:
+        """Reconfiguration under fire: spawn a deferred member fresh
+        against the running cluster.  The joiner boots the same
+        deterministic provisioned state (and bootstrap leader set) as
+        everyone else, dials the incumbents, and catches up to the
+        commit frontier via snapshot state transfer; the incumbents
+        pick its address up from the re-published peers.json on their
+        next poll."""
+        if node_id not in self.deferred:
+            raise ValueError(f"node {node_id} is not a deferred member")
+        handle = self.nodes[node_id]
+        if handle.alive:
+            raise RuntimeError(f"node {node_id} is already running")
+        deadline = time.monotonic() + timeout_s
+        os.makedirs(handle.dir, exist_ok=True)
+        write_json_atomic(
+            handle.spec_path,
+            self._spec(node_id, fresh=True, transport_port=0),
+        )
+        self._spawn(handle)
+        self._wait_address(handle, deadline)
+        self.deferred.discard(node_id)
+        self._booted.add(node_id)
+        if self.proxied:
+            for peer in sorted(self._booted):
+                if peer == node_id:
+                    continue
+                self.proxies[(node_id, peer)] = PartitionProxy(
+                    ("127.0.0.1", self.nodes[peer].transport_port)
+                )
+                self.proxies[(peer, node_id)] = PartitionProxy(
+                    ("127.0.0.1", handle.transport_port)
+                )
+        for peer in sorted(self._booted):
+            self._publish_peers(peer)
+        self._wait_ready(handle, deadline)
+        with self._lock:
+            client_transport = self._client_transport
+        if client_transport is not None:
+            client_transport.connect(
+                node_id, ("127.0.0.1", handle.transport_port)
+            )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -410,7 +492,9 @@ class ClusterSupervisor:
         for a in range(self.node_count):
             for b in range(self.node_count):
                 if a != b and group_of.get(a) != group_of.get(b):
-                    self.proxies[(a, b)].set_cut(cut)
+                    proxy = self.proxies.get((a, b))
+                    if proxy is not None:  # edge to a not-yet-joined node
+                        proxy.set_cut(cut)
 
     # -- client traffic ------------------------------------------------------
 

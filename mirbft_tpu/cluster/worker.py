@@ -21,13 +21,24 @@ isolation, kill -9 fidelity, and true parallelism, not distribution):
    flips ``/healthz`` to ``ready: true`` — so one HTTP poll tells the
    supervisor the true mesh is wired.
 
-State transfer is filesystem-mediated: each worker appends every
-checkpoint it computes to ``checkpoints.jsonl`` in its node directory,
-and a worker that falls behind scans its peers' checkpoint files for the
-target (the cross-process analogue of ``LiveReplica._serve_transfer``).
+State transfer runs over the real transport: each worker feeds its
+stable checkpoints (app chain + uncommitted-request slice) to a
+``runtime.transfer.TransferEngine``, which serves digest-chained
+snapshot chunks to behind peers on the transport's reserved transfer
+lane and fetches/verifies/installs them when this node is the one
+behind (staging the verified blob under the node dir, so SIGKILL
+mid-transfer resumes without the network after restart).  Workers still
+append every checkpoint they compute to ``checkpoints.jsonl`` — the
+supervisor's progress monitor reads it — and periodically publish the
+engine's counters to ``transfer.json`` for the chaos audits.
 Checkpoint records are soft state — rebuilt from consensus on restart —
 so they are flushed but not fsynced (durability fsyncs stay in
-storage.py and chaos/live.py, per lint rule W10).
+storage.py, transfer.py and chaos/live.py, per lint rules W10/W17).
+
+Workers also re-poll ``peers.json`` while running: when the supervisor
+grows the mesh (``join_node``), every incumbent picks up the newcomer's
+address on the next poll and dials it, so the joiner can receive
+checkpoint broadcasts and serve/fetch snapshots without any restart.
 
 On SIGTERM the worker drains the processor, closes storage cleanly, and
 dumps a final ``metrics.json`` registry snapshot; SIGKILL (the chaos
@@ -44,7 +55,7 @@ import threading
 import time
 
 from .. import pb
-from ..chaos.live import DurableChainLog
+from ..chaos.live import DurableChainLog, _TransportDuct
 from ..obsv import hooks
 from ..obsv.metrics import Registry
 from ..obsv.recorder import FlightRecorder
@@ -57,6 +68,7 @@ from ..runtime import (
     build_processor,
 )
 from ..runtime.node import NodeStopped, standard_initial_network_state
+from ..runtime.transfer import TransferEngine
 from ..runtime.transport import TcpTransport
 
 # How long the worker waits for the supervisor's peers.json before
@@ -136,16 +148,51 @@ class Worker:
             state = standard_initial_network_state(
                 int(spec["node_count"]), list(spec["client_ids"])
             )
-            self.node = Node.start_new(config, state)
+            # Scenario override (join/catch-up tests shrink the window so
+            # a joiner falls a full certified checkpoint behind quickly);
+            # identical in every spec, so fresh boots stay deterministic.
+            ci = spec.get("checkpoint_interval")
+            if ci:
+                state.config.checkpoint_interval = int(ci)
+                state.config.max_epoch_length = 10 * int(ci)
+            # A provisioned-but-not-yet-running member set (join-under-
+            # fire): boot every worker with the running subset as the
+            # bootstrap leaders, so absent members own no buckets until
+            # they actually join.
+            leaders = spec.get("initial_leaders")
+            self.node = Node.start_new(
+                config,
+                state,
+                initial_leaders=(
+                    [int(n) for n in leaders] if leaders else None
+                ),
+            )
         else:
             self.node = Node.restart(config, self.wal, self.reqstore)
         # Not ready until the peer mesh is dialed (phase 2 below).
         self.node.set_ready(False)
         self.transport = self._bind(int(spec.get("transport_port", 0)))
+        self.engine = TransferEngine(
+            self.node_id,
+            _TransportDuct(self.transport),
+            staging_dir=self.dir,
+            peers=[
+                p
+                for p in range(int(spec["node_count"]))
+                if p != self.node_id
+            ],
+            limits=config,
+            install=self._install_snapshot,
+            complete=self.node.state_transfer_complete,
+            failed=self.node.state_transfer_failed,
+            chunk_timeout_s=float(spec.get("transfer_chunk_timeout_s", 1.0)),
+        )
+        self.transport.set_transfer_sink(self.engine.on_frame)
         self._checkpoint_file = open(
             os.path.join(self.dir, "checkpoints.jsonl"), "a", encoding="utf-8"
         )
         self._announced: set = set()
+        self._dialed: set = set()
 
     # -- boot handshake ------------------------------------------------------
 
@@ -196,22 +243,7 @@ class Worker:
                 )
             time.sleep(0.02)
         self.transport.serve(self.node)
-        latency = self.spec.get("latency", {})
-        seed = int(self.spec.get("latency_seed", 0))
-        for peer_str, address in peers_doc["peers"].items():
-            peer_id = int(peer_str)
-            link = latency.get(peer_str) or latency.get(str(peer_id))
-            if link:
-                # Before connect(): the per-peer channel picks its
-                # LinkLatency up at creation, so no frame ever bypasses
-                # the emulated delay.
-                self.transport.set_link_latency(
-                    peer_id,
-                    float(link.get("delay_ms", 0.0)) / 1000.0,
-                    jitter_s=float(link.get("jitter_ms", 0.0)) / 1000.0,
-                    seed=seed,
-                )
-            self.transport.connect(peer_id, tuple(address))
+        self._dial_peers(peers_doc)
         self.processor = build_processor(
             self.node,
             self.transport.link(),
@@ -230,6 +262,35 @@ class Worker:
         # first autoflush threshold must still find a dump to annotate.
         self.recorder.flush("ready")
         self.node.set_ready(True)
+
+    def _dial_peers(self, peers_doc: dict) -> None:
+        """Dial every peer in a peers.json document that is not yet
+        connected.  Idempotent, so the run loop's periodic re-poll only
+        adds newcomers (supervisor ``join_node``) — and the transfer
+        engine's donor list grows with the mesh."""
+        latency = self.spec.get("latency", {})
+        seed = int(self.spec.get("latency_seed", 0))
+        added = False
+        for peer_str, address in peers_doc.get("peers", {}).items():
+            peer_id = int(peer_str)
+            if peer_id == self.node_id or peer_id in self._dialed:
+                continue
+            link = latency.get(peer_str) or latency.get(str(peer_id))
+            if link:
+                # Before connect(): the per-peer channel picks its
+                # LinkLatency up at creation, so no frame ever bypasses
+                # the emulated delay.
+                self.transport.set_link_latency(
+                    peer_id,
+                    float(link.get("delay_ms", 0.0)) / 1000.0,
+                    jitter_s=float(link.get("jitter_ms", 0.0)) / 1000.0,
+                    seed=seed,
+                )
+            self.transport.connect(peer_id, tuple(address))
+            self._dialed.add(peer_id)
+            added = True
+        if added:
+            self.engine.set_peers(sorted(self._dialed))
 
     # -- checkpoints / state transfer ---------------------------------------
 
@@ -255,37 +316,39 @@ class Worker:
                 + "\n"
             )
             self._checkpoint_file.flush()
+            requests: list = []
 
-    def _serve_transfer(self, target) -> None:
-        """Fill a state-transfer request from a peer's published
-        checkpoint file; fail it (the node re-requests later) when no
-        peer has announced the target yet."""
-        want_value = target.value.hex()
-        for peer in range(int(self.spec["node_count"])):
-            if peer == self.node_id:
-                continue
-            path = os.path.join(self.root, f"node{peer}", "checkpoints.jsonl")
-            try:
-                with open(path, "r", encoding="utf-8") as fh:
-                    lines = fh.readlines()
-            except OSError:
-                continue
-            for line in lines:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn tail of a concurrently-written file
-                if rec["seq"] == target.seq_no and rec["value"] == want_value:
-                    network_state = pb.decode(
-                        pb.NetworkState, bytes.fromhex(rec["state"])
-                    )
-                    self.app_log.adopt(target.value, target.seq_no)
-                    self.node.state_transfer_complete(target, network_state)
-                    return
-        self.node.state_transfer_failed(target)
+            def _collect(ack, _data=None):
+                # FileRequestStore.uncommitted hands only the ack; the
+                # payload is a separate read.
+                data = self.reqstore.get(ack)
+                if data is not None:
+                    requests.append((ack, data))
+
+            self.reqstore.uncommitted(_collect)
+            self.engine.note_checkpoint(
+                seq_no, cr.value, state, self.app_log.chain, requests
+            )
+
+    def _install_snapshot(self, snap):
+        """TransferEngine install callback: adopt the app chain (an
+        fsynced adopt record) and the donor's uncommitted-request slice,
+        then let the node persist the checkpoint CEntry."""
+        self.app_log.adopt(snap.value, snap.seq_no)
+        for ack, data in snap.requests:
+            self.reqstore.store(ack, data)
+        self.reqstore.sync()
+        return snap.network_state
+
+    def _publish_transfer_status(self) -> None:
+        """Expose the engine's phase and evidence counters for the
+        supervisor's chaos audits (corruption-rejection, catch-up)."""
+        try:
+            write_json_atomic(
+                os.path.join(self.dir, "transfer.json"), self.engine.status()
+            )
+        except OSError:
+            pass  # monitoring is best-effort; never kill the consumer
 
     # -- the consumer loop ---------------------------------------------------
 
@@ -293,6 +356,7 @@ class Worker:
         """Drive the node until SIGTERM (or serializer death); returns
         the process exit code."""
         last_tick = time.monotonic()
+        last_poll = last_tick
         code = 0
         try:
             while not self._stop.is_set():
@@ -307,7 +371,16 @@ class Worker:
                     last_tick = now
                     self.node.tick()
                 if actions is not None and actions.state_transfer is not None:
-                    self._serve_transfer(actions.state_transfer)
+                    self.engine.begin(actions.state_transfer)
+                self.engine.poll()
+                if now - last_poll >= 0.5:
+                    last_poll = now
+                    peers_doc = read_json(
+                        os.path.join(self.dir, "peers.json")
+                    )
+                    if peers_doc is not None:
+                        self._dial_peers(peers_doc)
+                    self._publish_transfer_status()
         except NodeStopped:
             pass
         except Exception as err:  # noqa: BLE001 — report, then die nonzero
@@ -335,6 +408,7 @@ class Worker:
             self.recorder.flush("exit" if graceful else "sigterm")
         except OSError:
             pass  # a full disk must not block the rest of teardown
+        self._publish_transfer_status()
         closer = getattr(self.processor, "close", None)
         if closer is not None:
             try:

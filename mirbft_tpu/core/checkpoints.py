@@ -167,6 +167,14 @@ class CheckpointTracker:
         return Applyable.CURRENT
 
     def step(self, source: int, msg: pb.Msg) -> None:
+        if source not in self.msg_buffers:
+            # A member of a newer config we have not adopted yet (node-set
+            # reconfiguration in flight — e.g. a freshly joined replica
+            # broadcasting checkpoints before we activate the grown
+            # config).  Its vote cannot count toward any quorum in *our*
+            # config, and after we adopt the new config the reinitialize
+            # rebuilds tallies from current members' retransmissions.
+            return
         verdict = self.filter(source, msg)
         if verdict is Applyable.PAST:
             return
@@ -210,6 +218,41 @@ class CheckpointTracker:
         for sn in list(self.checkpoint_map):
             if sn not in referenced:
                 del self.checkpoint_map[sn]
+
+    # -- state-transfer lag signal -------------------------------------------
+
+    def certified_above_window(self) -> tuple[int, bytes] | None:
+        """Highest above-window checkpoint holding an intersection quorum
+        (2f+1) on a single value, as ``(seq_no, value)`` — or None.
+
+        This is the state-transfer trigger *and* the adoption authority:
+        a value 2f+1 nodes vouch for intersects every other quorum in at
+        least one correct node, so a lagging replica may adopt a snapshot
+        anchored at it without replaying the log it missed.  f+1 would
+        prove some correct node holds the value, but not that the rest of
+        the network can make progress from it."""
+        best = None
+        high = self.high_watermark()
+        quorum = intersection_quorum(self.network_config)
+        for seq_no, cp in self.checkpoint_map.items():
+            if seq_no <= high:
+                continue
+            if best is not None and seq_no <= best[0]:
+                continue
+            for value, nodes in cp.votes.items():
+                if len(nodes) >= quorum:
+                    best = (seq_no, value)
+                    break
+        return best
+
+    def lag_seqnos(self) -> int:
+        """How far the network's newest certified frontier sits above our
+        own window (0 when caught up) — exported as the
+        ``mirbft_checkpoint_lag_seqnos`` gauge."""
+        certified = self.certified_above_window()
+        if certified is None:
+            return 0
+        return certified[0] - self.high_watermark()
 
     # -- garbage collection --------------------------------------------------
 

@@ -187,9 +187,24 @@ class EpochTracker:
             )
             self.current_epoch = self._new_target(last_ec.epoch_number)
             self.current_epoch.my_epoch_change = parse_epoch_change(epoch_change)
-            self.current_epoch.my_leader_choice = list(
-                self.network_config.nodes
-            )
+            # Leader choice on boot: honor the FEntry's leader set when it
+            # names one — a provisioned-but-absent member (cluster join:
+            # the node set includes a replica that has not started yet)
+            # must not be elected leader at epoch 0, or its buckets stall
+            # the whole network until the first suspicion round.  Every
+            # pre-existing FEntry names all nodes, so behavior there is
+            # unchanged; later epoch changes revert to all nodes
+            # (advance_state below).
+            leaders = list(self.network_config.nodes)
+            if last_f is not None:
+                from_f = [
+                    n
+                    for n in last_f.ends_epoch_config.leaders
+                    if n in self.network_config.nodes
+                ]
+                if from_f:
+                    leaders = from_f
+            self.current_epoch.my_leader_choice = leaders
 
         for node in self.network_config.nodes:
             self.future_msgs[node].iterate(

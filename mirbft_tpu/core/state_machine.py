@@ -357,11 +357,45 @@ class StateMachine:
             return self.client_tracker.step(source, msg)
         if cls is pb.Checkpoint:
             self.checkpoint_tracker.step(source, msg)
-            return _EMPTY_ACTIONS
+            return self._maybe_request_transfer()
         if cls is pb.FetchBatch or cls is pb.ForwardBatch:
             return self.batch_tracker.step(source, msg)
         # Everything else is epoch-scoped.
         return self.epoch_tracker.step(source, msg)
+
+    def _maybe_request_transfer(self) -> Actions:
+        """Lag check after every Checkpoint message: when an intersection
+        quorum certifies a checkpoint far enough above our window that the
+        network has GC'd past anything ordinary replay can fetch, request
+        state transfer to the certified target.  Also exports the lag
+        gauge, so dashboards see a node falling behind before the
+        transfer fires."""
+        tracker = self.checkpoint_tracker
+        certified = tracker.certified_above_window()
+        if hooks.enabled:
+            lag = (
+                certified[0] - tracker.high_watermark() if certified else 0
+            )
+            hooks.metrics.gauge("mirbft_checkpoint_lag_seqnos").set(lag)
+        if certified is None or self.commit_state.transferring:
+            return _EMPTY_ACTIONS
+        seq_no, value = certified
+        # Hysteresis: within two checkpoint windows of the frontier,
+        # peers still retain the batches (they GC to their own low
+        # watermark) and retransmission catches us up while we keep
+        # ordering.  Transferring eagerly here preempts normal
+        # participation — seen as a perpetual adopt-loop in the node-set
+        # growth scenario, where the freshly provisioned member chased
+        # every new certificate instead of executing batches.  Beyond
+        # the horizon, replay is impossible and transfer is the only way
+        # forward; a node stuck inside the horizon self-corrects, since
+        # the frontier keeps moving while it does not.
+        horizon = 2 * tracker.network_config.checkpoint_interval
+        if seq_no <= tracker.high_watermark() + horizon:
+            return _EMPTY_ACTIONS
+        if seq_no <= self.commit_state.highest_commit:
+            return _EMPTY_ACTIONS
+        return self.commit_state.transfer_to(seq_no, value)
 
     def _process_results(self, results: pb.EventActionResults) -> Actions:
         actions = Actions()

@@ -12,8 +12,8 @@ the bench ``live_mp_*`` rung.
 
 The consumer loop per node is the standard runtime embedding (see
 ``chaos.live.LiveReplica._consume``): ready → process → add_results,
-with wall-clock ticks and in-memory checkpoint serving for state
-transfer.
+with wall-clock ticks and the real TransferEngine (over a direct
+in-process duct, memory-only staging) serving state transfer.
 """
 
 from __future__ import annotations
@@ -26,6 +26,7 @@ from .. import pb
 from ..runtime import Config, Node, build_processor
 from ..runtime.node import NodeStopped, standard_initial_network_state
 from ..runtime.processor import Link, Log
+from ..runtime.transfer import TransferEngine
 
 
 class MemWal:
@@ -161,6 +162,20 @@ class _DirectLink(Link):
             pass
 
 
+class _DirectDuct:
+    """Same-process transfer duct: send == dest engine's on_frame."""
+
+    def __init__(self, cluster, source: int):
+        self.cluster = cluster
+        self.source = source
+
+    def send(self, dest: int, body: bytes) -> None:
+        replica = self.cluster.replicas[dest]
+        if replica is None:
+            return
+        replica.engine.on_frame(self.source, body)
+
+
 class _InProcReplica:
     def __init__(self, cluster, node_id: int, initial_state, processor: str):
         self.cluster = cluster
@@ -184,6 +199,17 @@ class _InProcReplica:
         self.checkpoints: dict = {}
         if hasattr(self.processor, "on_results"):
             self.processor.on_results = self._capture_checkpoints
+        self.engine = TransferEngine(
+            node_id,
+            _DirectDuct(cluster, node_id),
+            staging_dir=None,  # memory-only embedder: no crash resume
+            peers=list(initial_state.config.nodes),
+            limits=config,
+            install=self._install_snapshot,
+            complete=self.node.state_transfer_complete,
+            failed=self.node.state_transfer_failed,
+            chunk_timeout_s=0.25,
+        )
         self.failed = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -194,14 +220,32 @@ class _InProcReplica:
 
     def _capture_checkpoints(self, results) -> None:
         for cr in results.checkpoints:
-            self.checkpoints[cr.checkpoint.seq_no] = (
-                cr.value,
-                pb.NetworkState(
-                    config=cr.checkpoint.network_config,
-                    clients=cr.checkpoint.clients_state,
-                    pending_reconfigurations=list(cr.reconfigurations),
-                ),
+            network_state = pb.NetworkState(
+                config=cr.checkpoint.network_config,
+                clients=cr.checkpoint.clients_state,
+                pending_reconfigurations=list(cr.reconfigurations),
             )
+            self.checkpoints[cr.checkpoint.seq_no] = (cr.value, network_state)
+            requests: list = []
+            self.reqstore.uncommitted(
+                lambda ack, data: requests.append((ack, data))
+            )
+            self.engine.note_checkpoint(
+                cr.checkpoint.seq_no,
+                cr.value,
+                network_state,
+                self.app_log.chain,
+                requests,
+            )
+
+    def _install_snapshot(self, snap):
+        """TransferEngine install callback: adopt the app chain and the
+        donor's uncommitted-request slice, then let the node persist the
+        checkpoint CEntry."""
+        self.app_log.adopt(snap.value, snap.seq_no)
+        for ack, data in snap.requests:
+            self.reqstore.store(ack, data)
+        return snap.network_state
 
     def _consume(self) -> None:
         tick_seconds = self.cluster.tick_seconds
@@ -219,24 +263,12 @@ class _InProcReplica:
                     last_tick = now
                     self.node.tick()
                 if actions is not None and actions.state_transfer is not None:
-                    self._serve_transfer(actions.state_transfer)
+                    self.engine.begin(actions.state_transfer)
+                self.engine.poll()
         except NodeStopped:
             pass
         except Exception as err:  # noqa: BLE001 — surfaced via cluster.check()
             self.failed = err
-
-    def _serve_transfer(self, target) -> None:
-        for peer in self.cluster.replicas:
-            if peer is None or peer is self:
-                continue
-            entry = peer.checkpoints.get(target.seq_no)
-            if entry is None or entry[0] != target.value:
-                continue
-            value, network_state = entry
-            self.app_log.adopt(value, target.seq_no)
-            self.node.state_transfer_complete(target, network_state)
-            return
-        self.node.state_transfer_failed(target)
 
     def stop(self) -> None:
         self._stop.set()

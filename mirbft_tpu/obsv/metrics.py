@@ -41,7 +41,8 @@ CATALOG = {
     "mirbft_ack_events_total": "RequestAck events absorbed by an ack plane, by plane (host _FastAcks/scalar path vs device bitmask plane).",
     "mirbft_bench_stage_compile_seconds": "bench.py per-stage warmup/compile seconds (JAX/Mosaic compiles triggered before the timed window).",
     "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
-    "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/malformed).",
+    "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/oversized_snapshot_chunk/malformed).",
+    "mirbft_checkpoint_lag_seqnos": "Sequence distance from this node's checkpoint window to the newest 2f+1-certified above-window checkpoint (0 when caught up; the state-transfer trigger).",
     "mirbft_censored_commit_epochs": "Epoch rotations a censored-but-retried request needed before committing, per scenario.",
     "mirbft_chaos_dropped_total": "Messages dropped by chaos manglers, per scenario.",
     "mirbft_chaos_duplicated_total": "Messages duplicated by chaos manglers, per scenario.",
@@ -80,6 +81,8 @@ CATALOG = {
     "mirbft_sm_actions_total": "Actions emitted by StateMachine.apply_event, by kind.",
     "mirbft_sm_apply_seconds": "Wall time per StateMachine.apply_event call.",
     "mirbft_sm_events_total": "State-machine events applied, by event type.",
+    "mirbft_transfer_chunks_total": "State-transfer chunk frames, by outcome (served/received/rejected_corrupt/rejected_oversized/stale).",
+    "mirbft_transfer_snapshots_total": "State-transfer snapshot outcomes (served/nacked/installed/resumed_staged/donor_failover/retry/failed).",
     "mirbft_transport_frames_per_write": "Frames coalesced into each transport sendall.",
     "mirbft_transport_frames_total": "Transport frames, by outcome (enqueued/sent/dropped_overflow/dropped_closed/send_failure/dropped_unknown/dropped_fault).",
     "mirbft_transport_reconnects_total": "Transport dial attempts, by outcome (connected/failed/timeout/faulted).",
@@ -99,6 +102,7 @@ CATALOG_LABELS = {
     "mirbft_bench_stage_compile_seconds": ("stage",),
     "mirbft_bench_stage_seconds": ("stage",),
     "mirbft_byzantine_rejections_total": ("kind",),
+    "mirbft_checkpoint_lag_seqnos": (),
     "mirbft_censored_commit_epochs": ("scenario",),
     "mirbft_chaos_dropped_total": ("scenario",),
     "mirbft_chaos_duplicated_total": ("scenario",),
@@ -137,6 +141,8 @@ CATALOG_LABELS = {
     "mirbft_sm_actions_total": ("kind",),
     "mirbft_sm_apply_seconds": (),
     "mirbft_sm_events_total": ("type",),
+    "mirbft_transfer_chunks_total": ("outcome",),
+    "mirbft_transfer_snapshots_total": ("outcome",),
     "mirbft_transport_frames_per_write": (),
     "mirbft_transport_frames_total": ("outcome",),
     "mirbft_transport_reconnects_total": ("outcome",),
@@ -160,6 +166,10 @@ CARDINALITY = {
     # budget tight so a label typo cannot silently mint series.
     "mirbft_ack_batch_size": 4,
     "mirbft_ack_events_total": 4,
+    # Closed outcome sets (see CATALOG help text): a typo'd outcome label
+    # must fail loudly instead of minting series.
+    "mirbft_transfer_chunks_total": 8,
+    "mirbft_transfer_snapshots_total": 8,
 }
 
 

@@ -33,6 +33,13 @@ class Config:
     max_batch_acks: int = 256
     max_request_bytes: int = 1024 * 1024
     max_digest_bytes: int = 64
+    # State-transfer ingress bounds (runtime/transfer.py): per-chunk
+    # payload cap enforced on both the donor's chunking and the fetcher's
+    # ingress (msgfilter.check_snapshot_chunk — a byzantine donor must
+    # not be able to OOM a fetcher), and a total reassembled-snapshot
+    # cap bounding chunk-count floods.
+    max_snapshot_chunk_bytes: int = 256 * 1024
+    max_snapshot_bytes: int = 64 * 1024 * 1024
     # Optional callable(state_event) invoked inside the serializer before
     # each event application (the tracing hook; see eventlog.Recorder).
     event_interceptor: object = None
@@ -84,3 +91,9 @@ class Config:
             raise ValueError("shadow_stride must be >= 1")
         if self.ack_flush_rows is not None and self.ack_flush_rows < 1:
             raise ValueError("ack_flush_rows must be >= 1")
+        if self.max_snapshot_chunk_bytes < 1:
+            raise ValueError("max_snapshot_chunk_bytes must be >= 1")
+        if self.max_snapshot_bytes < self.max_snapshot_chunk_bytes:
+            raise ValueError(
+                "max_snapshot_bytes must be >= max_snapshot_chunk_bytes"
+            )

@@ -19,13 +19,16 @@ from .. import pb
 _DEFAULT_MAX_BATCH_ACKS = 256
 _DEFAULT_MAX_REQUEST_BYTES = 1024 * 1024
 _DEFAULT_MAX_DIGEST_BYTES = 64
+_DEFAULT_MAX_SNAPSHOT_CHUNK_BYTES = 256 * 1024
+_DEFAULT_MAX_SNAPSHOT_BYTES = 64 * 1024 * 1024
 
 
 class MalformedMessage(ValueError):
     """Preflight rejection.  ``kind`` labels the failure for the
     ``mirbft_byzantine_rejections_total`` taxonomy: ``malformed``
-    (structural), ``oversized_batch``, ``oversized_payload``, or
-    ``oversized_digest``."""
+    (structural), ``oversized_batch``, ``oversized_payload``,
+    ``oversized_digest``, or ``oversized_snapshot_chunk`` (state-transfer
+    ingress, see check_snapshot_chunk)."""
 
     def __init__(self, message: str, kind: str = "malformed"):
         super().__init__(message)
@@ -48,6 +51,33 @@ def _check_acks(acks, max_acks: int, max_digest: int, what: str) -> None:
         )
     for ack in acks:
         _check_digest(ack.digest, max_digest, f"{what} ack")
+
+
+def check_snapshot_chunk(
+    payload_len: int, total_chunks: int, limits=None
+) -> None:
+    """Ingress bound for state-transfer chunk frames (which are not
+    pb.Msg and so bypass pre_process): reject any chunk whose payload
+    exceeds the per-chunk cap, and any chunk count that would let the
+    full reassembly exceed the snapshot cap — a byzantine donor must not
+    be able to OOM a fetcher with one huge chunk or a chunk flood."""
+    max_chunk = getattr(
+        limits, "max_snapshot_chunk_bytes", _DEFAULT_MAX_SNAPSHOT_CHUNK_BYTES
+    )
+    max_total = getattr(
+        limits, "max_snapshot_bytes", _DEFAULT_MAX_SNAPSHOT_BYTES
+    )
+    if payload_len > max_chunk:
+        raise MalformedMessage(
+            f"snapshot chunk is {payload_len} bytes (max {max_chunk})",
+            kind="oversized_snapshot_chunk",
+        )
+    if total_chunks < 1 or total_chunks * max_chunk > max_total:
+        raise MalformedMessage(
+            f"snapshot of {total_chunks} chunks may exceed "
+            f"{max_total} bytes",
+            kind="oversized_snapshot_chunk",
+        )
 
 
 def pre_process(msg: pb.Msg, limits=None) -> None:

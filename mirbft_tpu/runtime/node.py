@@ -30,9 +30,19 @@ class _BootstrapWal:
     (reference: mirbft.go:162-190).  The serializer re-persists these into
     the real WAL so subsequent starts use restart_node."""
 
-    def __init__(self, initial_network_state, initial_checkpoint_value):
+    def __init__(
+        self,
+        initial_network_state,
+        initial_checkpoint_value,
+        initial_leaders=None,
+    ):
         self.initial_network_state = initial_network_state
         self.initial_checkpoint_value = initial_checkpoint_value
+        # Epoch-0 leader set; defaults to every node.  A cluster that
+        # provisions not-yet-started members (join_node) boots with the
+        # running subset as leaders so the absent member's buckets don't
+        # stall the network until the first suspicion round.
+        self.initial_leaders = initial_leaders
 
     def load_all(self, for_each):
         for_each(
@@ -51,7 +61,11 @@ class _BootstrapWal:
                 type=pb.FEntry(
                     ends_epoch_config=pb.EpochConfig(
                         number=0,
-                        leaders=self.initial_network_state.config.nodes,
+                        leaders=(
+                            self.initial_leaders
+                            if self.initial_leaders is not None
+                            else self.initial_network_state.config.nodes
+                        ),
                     )
                 )
             ),
@@ -146,10 +160,15 @@ class Node:
         config: Config,
         initial_network_state: pb.NetworkState,
         initial_checkpoint_value: bytes = b"",
+        initial_leaders=None,
     ) -> "Node":
         return cls(
             config,
-            _BootstrapWal(initial_network_state, initial_checkpoint_value),
+            _BootstrapWal(
+                initial_network_state,
+                initial_checkpoint_value,
+                initial_leaders=initial_leaders,
+            ),
             _EmptyReqStore(),
         )
 

@@ -585,3 +585,47 @@ class FileRequestStore:
         self._group.stop(flush=False)
         with self._lock:
             self._file.close()
+
+
+# -- snapshot staging (state transfer) ---------------------------------------
+#
+# The transfer engine (runtime/transfer.py) stages a verified snapshot to
+# disk *before* adoption so a crash mid-install restarts cleanly: the core
+# re-emits state_transfer on restart (TEntry > CEntry in the WAL), the
+# engine finds the staged blob for the same target, and completes the
+# install without re-fetching.  All fsync-bearing snapshot file I/O lives
+# here (lint rules W10/W17).
+
+
+def write_snapshot_file(path: str, blob: bytes) -> None:
+    """Atomically persist a snapshot blob: tmp + fsync + rename + dir
+    fsync, so ``path`` either holds the complete blob or does not exist —
+    a torn staging file can never be mistaken for a verified snapshot."""
+    directory = os.path.dirname(path) or "."
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(directory)
+
+
+def read_snapshot_file(path: str) -> bytes | None:
+    """Read a staged snapshot blob, or None when absent."""
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def remove_snapshot_file(path: str) -> None:
+    """Discard a staged snapshot (post-install or on target change); the
+    unlink is made durable so a crash cannot resurrect a consumed blob."""
+    directory = os.path.dirname(path) or "."
+    try:
+        os.unlink(path)
+    except OSError:
+        return
+    _fsync_dir(directory)

@@ -94,6 +94,13 @@ _HELLO_SRC = 1 << 62
 # instead of opening raw sockets of their own (lint rule W9).
 _PROPOSE_SRC = (1 << 62) + 1
 
+# Reserved frame source id marking a state-transfer frame: the payload
+# after the id is an opaque transfer body (runtime/transfer.py codec, not
+# a pb.Msg), delivered to the sink installed via set_transfer_sink.
+# Snapshot chunks ride the same sockets and per-peer queues as protocol
+# traffic, so partitions/latency/adversary seams apply to them for free.
+_XFER_SRC = (1 << 62) + 2
+
 
 class LinkLatency:
     """Emulated one-way link latency: frames to the peer are held on the
@@ -440,6 +447,9 @@ class TcpTransport:
         self._scratch = threading.local()
         self._src_prefix = wire.encode_varint(node_id)
         self._node = None
+        # Inbound state-transfer frames (see set_transfer_sink); None
+        # until a transfer engine attaches, and such frames drop.
+        self._transfer_sink = None
         self._peers: dict[int, tuple] = {}  # guarded-by: _lock
         # id -> (socket, per-connection send lock).  The transport-wide
         # _lock guards only the maps; each peer's sends run on its own
@@ -590,6 +600,40 @@ class TcpTransport:
             return
         channel.enqueue(frame)
 
+    def send_transfer(self, dest: int, body: bytes) -> None:
+        """State-transfer lane: frame an opaque transfer body (the
+        runtime/transfer.py chunk codec) under the reserved ``_XFER_SRC``
+        id and enqueue it to ``dest``.  The receiving transport hands
+        ``(sender_id, body)`` to the sink installed via
+        ``set_transfer_sink``.  Fire-and-forget like ``send``: the
+        transfer engine owns timeouts, retry, and donor failover."""
+        payload = (
+            wire.encode_varint(_XFER_SRC)
+            + wire.encode_varint(self.node_id)
+            + body
+        )
+        frame = _LEN.pack(len(payload)) + payload
+        fault = self.fault
+        if fault is not None and not fault.on_send(dest, frame):
+            with self._lock:
+                self.dropped_fault += 1
+            _frame_outcome("dropped_fault")
+            return
+        channel = self._channel(dest)
+        if channel is None:
+            with self._lock:
+                self.dropped_unknown += 1
+            _frame_outcome("dropped_unknown")
+            return
+        channel.enqueue(frame)
+
+    def set_transfer_sink(self, sink) -> None:
+        """Install the inbound state-transfer handler: ``sink(sender_id,
+        body)`` is called on a transport read thread for every
+        ``_XFER_SRC`` frame and must not block (the transfer engine
+        queues the frame and returns)."""
+        self._transfer_sink = sink
+
     def counters(self) -> dict:
         """Per-peer drop/retry accounting for dashboards and chaos gates
         (see status.transport_status for the dataclass view)."""
@@ -693,6 +737,12 @@ class TcpTransport:
                     self._clock_offsets[peer_id] = (
                         time.perf_counter_ns() - remote_ns
                     )
+                return
+            if source == _XFER_SRC:
+                sender_id, offset = wire.decode_varint(payload, offset)
+                sink = self._transfer_sink
+                if sink is not None:
+                    sink(sender_id, payload[offset:])
                 return
             if source == _PROPOSE_SRC:
                 _client_ep, offset = wire.decode_varint(payload, offset)

@@ -259,6 +259,63 @@ def transport_status(transport) -> TransportStatus:
 
 
 @dataclass
+class TransferStatus:
+    """Snapshot of a runtime.transfer.TransferEngine: fetch progress on
+    the fetcher side, cached anchors on the donor side, and the evidence
+    counters the chaos audits read."""
+
+    node_id: int
+    phase: str
+    target_seq_no: int | None
+    donor: int | None
+    chunks_received: int
+    total_chunks: int | None
+    cached_snapshots: list = field(default_factory=list)
+    counters: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, default=str)
+
+    def pretty(self) -> str:
+        lines = [f"=== State transfer (node {self.node_id}) ==="]
+        if self.phase == "idle":
+            lines.append("  idle")
+        else:
+            total = self.total_chunks if self.total_chunks is not None else "?"
+            lines.append(
+                f"  {self.phase} target=seq {self.target_seq_no} "
+                f"donor={self.donor} chunks={self.chunks_received}/{total}"
+            )
+        if self.cached_snapshots:
+            lines.append(
+                "  servable anchors: "
+                + ", ".join(str(s) for s in self.cached_snapshots)
+            )
+        interesting = {k: v for k, v in sorted(self.counters.items()) if v}
+        if interesting:
+            lines.append(
+                "  "
+                + " ".join(f"{k}={v}" for k, v in interesting.items())
+            )
+        return "\n".join(lines)
+
+
+def transfer_status(engine) -> TransferStatus:
+    """Snapshot a runtime.transfer.TransferEngine."""
+    snap = engine.status()
+    return TransferStatus(
+        node_id=engine.node_id,
+        phase=snap["phase"],
+        target_seq_no=snap["target_seq_no"],
+        donor=snap["donor"],
+        chunks_received=snap["chunks_received"],
+        total_chunks=snap["total_chunks"],
+        cached_snapshots=snap["cached_snapshots"],
+        counters=snap["counters"],
+    )
+
+
+@dataclass
 class BreakerStatus:
     state: str
     consecutive_failures: int

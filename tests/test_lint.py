@@ -519,6 +519,64 @@ def test_linter_confines_adversary_tooling_to_harness(tmp_path):
     assert not any("W13" in line for line in lint.check_file(tests_ok))
 
 
+def test_linter_confines_snapshot_io_to_storage_and_transfer(tmp_path):
+    """W17: staged-snapshot file I/O (write/read/remove_snapshot_file)
+    is confined to runtime/storage.py (the atomic primitives) and
+    runtime/transfer.py (their single caller, the TransferEngine's
+    crash-resume staging); a third call site would fork the staged-blob
+    crash contract."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "chaos" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text(
+        "from ..runtime.storage import write_snapshot_file\n"
+        "write_snapshot_file('p', b'x')\n"
+    )
+    findings = lint.check_file(outside)
+    assert any("W17" in line for line in findings), findings
+
+    attr = tmp_path / "mirbft_tpu" / "cluster" / "sneaky2.py"
+    attr.parent.mkdir(parents=True)
+    attr.write_text(
+        "from ..runtime import storage\n"
+        "blob = storage.read_snapshot_file('p')\n"
+        "x = blob\n"
+    )
+    assert any("W17" in line for line in lint.check_file(attr))
+
+    cleanup = tmp_path / "mirbft_tpu" / "runtime" / "sneaky3.py"
+    cleanup.parent.mkdir(parents=True)
+    cleanup.write_text(
+        "from .storage import remove_snapshot_file\n"
+        "remove_snapshot_file('p')\n"
+    )
+    assert any("W17" in line for line in lint.check_file(cleanup))
+
+    # The two sanctioned files, checked against the real sources.
+    assert not any(
+        "W17" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "runtime" / "storage.py"
+        )
+    )
+    assert not any(
+        "W17" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "runtime" / "transfer.py"
+        )
+    )
+
+    # Tests and tools are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text(
+        "from mirbft_tpu.runtime.storage import read_snapshot_file\n"
+        "x = read_snapshot_file('p')\n"
+    )
+    assert not any("W17" in line for line in lint.check_file(tests_ok))
+
+
 # ---------------------------------------------------------------------------
 # rule engine (tools/analysis/engine.py)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,660 @@
+"""The snapshot state-transfer subsystem (runtime/transfer.py), tested
+deterministically — fake clock, in-memory queued "ducts", no sockets:
+blob/frame codecs, the digest chain, donor serve/NACK, the fetch state
+machine (timeout, retry, donor failover), certificate verification as
+the adoption authority, and crash-resume from the staged blob.  The
+slow section drives the same subsystem under fire: a fresh process
+joining a loaded multi-process cluster through a partition, and a live
+adversary corrupting the transfer stream on real TCP sockets."""
+
+import types
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.chaos.invariants import (
+    InvariantViolation,
+    check_bounded_catchup,
+    check_transfer_corruption_rejected,
+)
+from mirbft_tpu.core.actions import StateTarget
+from mirbft_tpu.core.checkpoints import CheckpointTracker
+from mirbft_tpu.core.msgbuffers import NodeBuffers
+from mirbft_tpu.core.persisted import Persisted
+from mirbft_tpu.runtime.config import Config
+from mirbft_tpu.runtime.msgfilter import MalformedMessage, check_snapshot_chunk
+from mirbft_tpu.runtime.storage import read_snapshot_file, write_snapshot_file
+from mirbft_tpu.runtime.transfer import (
+    Snapshot,
+    TransferEngine,
+    chain_next,
+    chain_seed,
+    decode_frame,
+    decode_snapshot,
+    encode_chunk,
+    encode_request,
+    encode_snapshot,
+    split_chunks,
+)
+
+
+# -- harness -----------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class _Mesh:
+    """Queued loopback ducts: sends enqueue, ``deliver`` flushes — the
+    queue models the transport's cross-thread hop, so an engine never
+    re-enters its own lock the way a synchronous callback would."""
+
+    def __init__(self):
+        self.engines = {}
+        self.pending = []  # [(src, dest, body)]
+        self.log = []  # every send ever, for traffic assertions
+        self.cut = set()  # (src, dest) pairs to drop
+
+    def duct(self, src):
+        mesh = self
+
+        class _Duct:
+            def send(self, dest, body):
+                mesh.log.append((src, dest, body))
+                mesh.pending.append((src, dest, body))
+
+        return _Duct()
+
+    def add(self, engine):
+        self.engines[engine.node_id] = engine
+
+    def deliver(self, mangle=None):
+        while self.pending:
+            src, dest, body = self.pending.pop(0)
+            if (src, dest) in self.cut:
+                continue
+            engine = self.engines.get(dest)
+            if engine is None:
+                continue
+            engine.on_frame(src, mangle(body) if mangle else body)
+
+
+def _network_state():
+    return pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=[0, 1, 2, 3],
+            f=1,
+            number_of_buckets=4,
+            checkpoint_interval=5,
+            max_epoch_length=50,
+        )
+    )
+
+
+def _snapshot(seq_no=10, value=b"cp10", app=b"app-state"):
+    requests = [
+        (pb.RequestAck(client_id=1, req_no=3, digest=b"d" * 8), b"payload"),
+        (pb.RequestAck(client_id=2, req_no=0, digest=b"e" * 8), b""),
+    ]
+    return Snapshot(seq_no, value, _network_state(), app, requests)
+
+
+def _engine(mesh, clock, tmp_path, node_id, peers=(), **kw):
+    staging = tmp_path / f"n{node_id}"
+    staging.mkdir(exist_ok=True)
+    sink = types.SimpleNamespace(completed=[], failed=[])
+    engine = TransferEngine(
+        node_id,
+        mesh.duct(node_id),
+        staging_dir=str(staging),
+        peers=peers,
+        complete=lambda target, ns: sink.completed.append((target, ns)),
+        failed=lambda target: sink.failed.append(target),
+        chunk_timeout_s=1.0,
+        clock=clock,
+        **kw,
+    )
+    mesh.add(engine)
+    return engine, sink
+
+
+def _pump(mesh, fetcher, clock, rounds=40, dt=1.1):
+    """Advance time past any timeout/backoff and poll until the fetch
+    leaves the state machine (installed or failed)."""
+    for _ in range(rounds):
+        fetcher.poll()
+        mesh.deliver()
+        fetcher.poll()
+        if not fetcher.transferring():
+            return
+        clock.advance(dt)
+    raise AssertionError(f"fetch never settled: {fetcher.status()}")
+
+
+# -- codecs ------------------------------------------------------------------
+
+
+def test_snapshot_blob_round_trips():
+    snap = _snapshot()
+    blob = encode_snapshot(snap)
+    out = decode_snapshot(blob)
+    assert out.seq_no == snap.seq_no
+    assert out.value == snap.value
+    assert out.app_bytes == snap.app_bytes
+    assert out.network_state.config.nodes == [0, 1, 2, 3]
+    assert [(a.client_id, a.req_no, a.digest) for a, _d in out.requests] == [
+        (1, 3, b"d" * 8),
+        (2, 0, b"e" * 8),
+    ]
+    assert [d for _a, d in out.requests] == [b"payload", b""]
+
+
+def test_snapshot_blob_rejects_malformation():
+    blob = encode_snapshot(_snapshot())
+    with pytest.raises(ValueError):
+        decode_snapshot(blob + b"\x00")  # trailing bytes
+    with pytest.raises(ValueError):
+        decode_snapshot(blob[:-1])  # truncation
+    with pytest.raises(ValueError):
+        decode_snapshot(b"")
+
+
+def test_transfer_frames_round_trip():
+    req = encode_request(40, b"cert-value", 3)
+    assert decode_frame(req) == ("request", 40, b"cert-value", 3)
+    digest = chain_seed(40, b"cert-value")
+    chunk = encode_chunk(40, 2, 7, digest, b"chunk-payload")
+    assert decode_frame(chunk) == (
+        "chunk",
+        40,
+        2,
+        7,
+        digest,
+        b"chunk-payload",
+    )
+    with pytest.raises(ValueError):
+        decode_frame(b"\x7f")  # unknown kind
+    with pytest.raises(ValueError):
+        decode_frame(chunk[:10])  # truncated mid-frame
+
+
+def test_chunk_split_and_digest_chain():
+    blob = bytes(range(256)) * 10
+    payloads = split_chunks(blob, 1000)
+    assert b"".join(payloads) == blob
+    assert max(len(p) for p in payloads) <= 1000
+    assert split_chunks(b"", 64) == [b""]  # empty blob still round-trips
+    with pytest.raises(ValueError):
+        split_chunks(blob, 0)
+    # The chain is anchored to the certified target: any other
+    # (seq_no, value) produces a different seed, so chunk 0 already
+    # fails verification when served for the wrong certificate.
+    assert chain_seed(10, b"a") != chain_seed(11, b"a")
+    assert chain_seed(10, b"a") != chain_seed(10, b"b")
+    d = chain_seed(10, b"a")
+    assert chain_next(d, b"x") != chain_next(d, b"y")
+
+
+# -- donor side --------------------------------------------------------------
+
+
+def test_donor_serves_matching_request_and_nacks_unknown(tmp_path):
+    mesh, clock = _Mesh(), _Clock()
+    donor, _ = _engine(mesh, clock, tmp_path, 1)
+    snap = _snapshot()
+    donor.note_checkpoint(
+        snap.seq_no, snap.value, snap.network_state, snap.app_bytes,
+        snap.requests,
+    )
+
+    donor.on_frame(0, encode_request(snap.seq_no, snap.value, 0))
+    frames = [decode_frame(b) for _s, _d, b in mesh.log]
+    chunks = [f for f in frames if f[0] == "chunk"]
+    assert chunks and b"".join(f[5] for f in chunks) == encode_snapshot(snap)
+    assert donor.counters["snapshots_served"] == 1
+
+    # Unknown seq_no and certificate-value mismatch both NACK so the
+    # fetcher fails over immediately instead of burning a timeout.
+    mesh.log.clear()
+    donor.on_frame(0, encode_request(999, snap.value, 0))
+    donor.on_frame(0, encode_request(snap.seq_no, b"other-cert", 0))
+    assert [decode_frame(b)[0] for _s, _d, b in mesh.log] == ["nack", "nack"]
+    assert donor.counters["snapshots_nacked"] == 2
+
+
+def test_donor_retains_only_newest_snapshots(tmp_path):
+    mesh, clock = _Mesh(), _Clock()
+    donor, _ = _engine(mesh, clock, tmp_path, 1)
+    for seq in (10, 20, 30, 40, 50, 60):
+        snap = _snapshot(seq_no=seq, value=b"cp%d" % seq)
+        donor.note_checkpoint(
+            seq, snap.value, snap.network_state, snap.app_bytes, snap.requests
+        )
+    assert donor.status()["cached_snapshots"] == [30, 40, 50, 60]
+
+
+# -- fetcher: the happy path -------------------------------------------------
+
+
+def test_fetch_installs_verified_snapshot(tmp_path):
+    mesh, clock = _Mesh(), _Clock()
+    donor, _ = _engine(mesh, clock, tmp_path, 1)
+    fetcher, sink = _engine(mesh, clock, tmp_path, 0, peers=(1,))
+    snap = _snapshot()
+    donor.note_checkpoint(
+        snap.seq_no, snap.value, snap.network_state, snap.app_bytes,
+        snap.requests,
+    )
+
+    fetcher.begin(StateTarget(seq_no=snap.seq_no, value=snap.value))
+    _pump(mesh, fetcher, clock)
+
+    assert fetcher.counters["snapshots_installed"] == 1
+    assert fetcher.counters["chunks_rejected_corrupt"] == 0
+    (target, network_state), = sink.completed
+    assert (target.seq_no, target.value) == (snap.seq_no, snap.value)
+    assert network_state.config.nodes == [0, 1, 2, 3]
+    assert not sink.failed
+    # The staged blob is consumed on install — a later restart must not
+    # resurrect an already-adopted snapshot.
+    assert read_snapshot_file(fetcher.staging_path) is None
+
+
+def test_begin_is_idempotent_for_inflight_target(tmp_path):
+    mesh, clock = _Mesh(), _Clock()
+    fetcher, _ = _engine(mesh, clock, tmp_path, 0, peers=(1, 2))
+    target = StateTarget(seq_no=10, value=b"cp10")
+    fetcher.begin(target)
+    fetcher.poll()  # sends the first request
+    sent = len(mesh.log)
+    fetcher.begin(StateTarget(seq_no=10, value=b"cp10"))
+    fetcher.poll()
+    assert len(mesh.log) == sent  # no duplicate stream started
+
+
+# -- fetcher: corruption, certificates, bounds -------------------------------
+
+
+def test_corrupted_chunk_rejected_with_evidence(tmp_path):
+    """Every mangled frame breaks the digest chain and is refused —
+    nothing corrupt is ever staged or installed."""
+    mesh, clock = _Mesh(), _Clock()
+    donor, _ = _engine(mesh, clock, tmp_path, 1)
+    fetcher, sink = _engine(
+        mesh, clock, tmp_path, 0, peers=(1,), donor_rounds=1
+    )
+    snap = _snapshot()
+    donor.note_checkpoint(
+        snap.seq_no, snap.value, snap.network_state, snap.app_bytes,
+        snap.requests,
+    )
+
+    def flip_payload_tail(body):
+        if decode_frame(body)[0] != "chunk":
+            return body
+        return body[:-1] + bytes([body[-1] ^ 0xFF])
+
+    fetcher.begin(StateTarget(seq_no=snap.seq_no, value=snap.value))
+    fetcher.poll()
+    mesh.deliver(mangle=flip_payload_tail)
+    for _ in range(10):
+        fetcher.poll()
+        clock.advance(1.1)
+
+    assert fetcher.counters["chunks_rejected_corrupt"] >= 1
+    assert fetcher.counters["snapshots_installed"] == 0
+    assert read_snapshot_file(fetcher.staging_path) is None
+    assert sink.failed and not sink.completed
+
+
+def test_chain_valid_but_wrong_blob_rejected_at_certificate(tmp_path):
+    """A byzantine donor can chain arbitrary bytes to the right anchor;
+    the decoded blob must still carry the certified (seq_no, value) —
+    the 2f+1 certificate, not the chain, is the adoption authority."""
+    mesh, clock = _Mesh(), _Clock()
+    fetcher, sink = _engine(
+        mesh, clock, tmp_path, 0, peers=(1,), donor_rounds=1
+    )
+    target = StateTarget(seq_no=10, value=b"cp10")
+    fetcher.begin(target)
+    fetcher.poll()  # now fetching from donor 1
+
+    wrong = encode_snapshot(_snapshot(seq_no=11, value=b"cp11"))
+    digest = chain_seed(target.seq_no, target.value)
+    payloads = split_chunks(wrong, 64)
+    for index, payload in enumerate(payloads):
+        digest = chain_next(digest, payload)
+        fetcher.on_frame(
+            1, encode_chunk(target.seq_no, index, len(payloads), digest, payload)
+        )
+    assert fetcher.counters["chunks_received"] == len(payloads)
+
+    for _ in range(10):
+        fetcher.poll()
+        clock.advance(1.1)
+    assert fetcher.counters["chunks_rejected_corrupt"] >= 1
+    assert fetcher.counters["snapshots_installed"] == 0
+    assert sink.failed and not sink.completed
+
+
+def test_oversized_chunk_rejected_at_ingress(tmp_path):
+    mesh, clock = _Mesh(), _Clock()
+    limits = types.SimpleNamespace(
+        max_snapshot_chunk_bytes=8, max_snapshot_bytes=64
+    )
+    fetcher, _ = _engine(
+        mesh, clock, tmp_path, 0, peers=(1,), donor_rounds=1, limits=limits
+    )
+    target = StateTarget(seq_no=10, value=b"cp10")
+    fetcher.begin(target)
+    fetcher.poll()
+    digest = chain_next(chain_seed(10, b"cp10"), b"x" * 100)
+    fetcher.on_frame(1, encode_chunk(10, 0, 1, digest, b"x" * 100))
+    assert fetcher.counters["chunks_rejected_oversized"] == 1
+    assert fetcher.counters["chunks_received"] == 0
+
+
+def test_stale_and_unsolicited_chunks_dropped(tmp_path):
+    mesh, clock = _Mesh(), _Clock()
+    fetcher, _ = _engine(mesh, clock, tmp_path, 0, peers=(1, 2))
+    digest = chain_next(chain_seed(10, b"cp10"), b"p")
+    # No fetch in flight at all: unsolicited chunk.
+    fetcher.on_frame(1, encode_chunk(10, 0, 1, digest, b"p"))
+    assert fetcher.counters["chunks_stale"] == 1
+    # In flight, but from a node that is not the current donor.
+    fetcher.begin(StateTarget(seq_no=10, value=b"cp10"))
+    fetcher.poll()
+    donor = fetcher.status()["donor"]
+    other = 2 if donor == 1 else 1
+    fetcher.on_frame(other, encode_chunk(10, 0, 1, digest, b"p"))
+    assert fetcher.counters["chunks_stale"] == 2
+    assert fetcher.counters["chunks_received"] == 0
+
+
+# -- fetcher: timeout, retry, failover, failure ------------------------------
+
+
+def test_donor_failover_after_timeouts(tmp_path):
+    """The first donor is unreachable: per-chunk timeouts burn its
+    attempts, the fetch fails over, and the second donor completes it."""
+    mesh, clock = _Mesh(), _Clock()
+    donor1, _ = _engine(mesh, clock, tmp_path, 1)
+    donor2, _ = _engine(mesh, clock, tmp_path, 2)
+    fetcher, sink = _engine(mesh, clock, tmp_path, 0, peers=(1, 2))
+    snap = _snapshot()
+    for donor in (donor1, donor2):
+        donor.note_checkpoint(
+            snap.seq_no, snap.value, snap.network_state, snap.app_bytes,
+            snap.requests,
+        )
+
+    fetcher.begin(StateTarget(seq_no=snap.seq_no, value=snap.value))
+    fetcher.poll()
+    first = fetcher.status()["donor"]
+    mesh.cut.add((0, first))  # requests to the first donor vanish
+
+    _pump(mesh, fetcher, clock)
+    assert fetcher.counters["snapshots_installed"] == 1
+    assert fetcher.counters["request_timeouts"] >= 2
+    assert fetcher.counters["retries"] >= 1  # same-donor retry first
+    assert fetcher.counters["donor_failovers"] >= 1
+    assert sink.completed and not sink.failed
+
+
+def test_nack_fails_over_without_waiting_for_timeout(tmp_path):
+    """Only the donor the shuffle did NOT pick first holds the snapshot:
+    the first donor NACKs, and the rotation happens on the NACK itself —
+    the clock never advances, so no timeout can be responsible."""
+    mesh, clock = _Mesh(), _Clock()
+    donor1, _ = _engine(mesh, clock, tmp_path, 1)
+    donor2, _ = _engine(mesh, clock, tmp_path, 2)
+    fetcher, sink = _engine(mesh, clock, tmp_path, 0, peers=(1, 2))
+    snap = _snapshot()
+    fetcher.begin(StateTarget(seq_no=snap.seq_no, value=snap.value))
+    fetcher.poll()
+    first = fetcher.status()["donor"]
+    nacker = donor1 if first == 1 else donor2
+    holder = donor2 if first == 1 else donor1
+    holder.note_checkpoint(
+        snap.seq_no, snap.value, snap.network_state, snap.app_bytes,
+        snap.requests,
+    )
+    # One flush settles the whole exchange: request -> NACK -> rotated
+    # request -> chunks; then one poll installs.
+    mesh.deliver()
+    fetcher.poll()
+    assert fetcher.counters["snapshots_installed"] == 1
+    assert fetcher.counters["request_timeouts"] == 0
+    assert fetcher.counters["donor_failovers"] == 1
+    assert nacker.counters["snapshots_nacked"] == 1
+    assert sink.completed and not sink.failed
+
+
+def test_all_donors_exhausted_reports_failure_and_recovers(tmp_path):
+    mesh, clock = _Mesh(), _Clock()
+    fetcher, sink = _engine(
+        mesh, clock, tmp_path, 0, peers=(1, 2), donor_rounds=2
+    )
+    target = StateTarget(seq_no=10, value=b"cp10")
+    fetcher.begin(target)
+    _pump(mesh, fetcher, clock)  # nobody answers: every round times out
+    assert fetcher.counters["snapshots_failed"] == 1
+    assert sink.failed == [target] and not sink.completed
+    assert fetcher.status()["phase"] == "idle"
+
+    # failed() is a retry contract, not a dead end: the core re-emits
+    # state_transfer and begin() must start a fresh fetch.
+    donor, _ = _engine(mesh, clock, tmp_path, 1)
+    snap = _snapshot()
+    donor.note_checkpoint(
+        snap.seq_no, snap.value, snap.network_state, snap.app_bytes,
+        snap.requests,
+    )
+    fetcher.begin(target)
+    _pump(mesh, fetcher, clock)
+    assert fetcher.counters["snapshots_installed"] == 1
+
+
+# -- crash-resume from the staged blob ---------------------------------------
+
+
+def test_restart_resumes_from_staged_blob_without_network(tmp_path):
+    """Crash between staging and install: the restarted engine finds the
+    staged blob for the re-emitted target and completes with zero
+    network traffic."""
+    mesh, clock = _Mesh(), _Clock()
+    snap = _snapshot()
+    blob = encode_snapshot(snap)
+    staging = tmp_path / "n0"
+    staging.mkdir()
+    write_snapshot_file(str(staging / "snapshot.staged"), blob)
+
+    fetcher, sink = _engine(mesh, clock, tmp_path, 0, peers=(1, 2))
+    fetcher.begin(StateTarget(seq_no=snap.seq_no, value=snap.value))
+    fetcher.poll()
+    assert fetcher.counters["snapshots_resumed_staged"] == 1
+    assert fetcher.counters["snapshots_installed"] == 1
+    assert sink.completed and not sink.failed
+    assert mesh.log == []  # completed locally: no request ever sent
+    assert read_snapshot_file(fetcher.staging_path) is None
+
+
+def test_stale_staged_blob_discarded_and_fetched_fresh(tmp_path):
+    """A staged blob for a different target (an older, superseded fetch)
+    must not be adopted: it is discarded and the network fetch begins."""
+    mesh, clock = _Mesh(), _Clock()
+    stale = encode_snapshot(_snapshot(seq_no=5, value=b"cp5"))
+    staging = tmp_path / "n0"
+    staging.mkdir()
+    write_snapshot_file(str(staging / "snapshot.staged"), stale)
+
+    donor, _ = _engine(mesh, clock, tmp_path, 1)
+    snap = _snapshot()
+    donor.note_checkpoint(
+        snap.seq_no, snap.value, snap.network_state, snap.app_bytes,
+        snap.requests,
+    )
+    fetcher, sink = _engine(mesh, clock, tmp_path, 0, peers=(1,))
+    fetcher.begin(StateTarget(seq_no=snap.seq_no, value=snap.value))
+    _pump(mesh, fetcher, clock)
+    assert fetcher.counters["snapshots_resumed_staged"] == 0
+    assert fetcher.counters["snapshots_installed"] == 1
+    (target, _ns), = sink.completed
+    assert target.seq_no == snap.seq_no  # the new target, not the stale one
+
+
+# -- ingress bounds and config validation ------------------------------------
+
+
+def test_check_snapshot_chunk_bounds():
+    limits = types.SimpleNamespace(
+        max_snapshot_chunk_bytes=1024, max_snapshot_bytes=16 * 1024
+    )
+    check_snapshot_chunk(1024, 16, limits)  # exactly at both caps
+    with pytest.raises(MalformedMessage) as err:
+        check_snapshot_chunk(1025, 1, limits)
+    assert err.value.kind == "oversized_snapshot_chunk"
+    with pytest.raises(MalformedMessage):
+        check_snapshot_chunk(0, 0, limits)  # zero chunks is malformed
+    with pytest.raises(MalformedMessage):
+        check_snapshot_chunk(10, 17, limits)  # reassembly could exceed cap
+
+
+def test_config_validates_snapshot_bounds():
+    Config(id=0)  # defaults are self-consistent
+    with pytest.raises(ValueError):
+        Config(id=0, max_snapshot_chunk_bytes=0)
+    with pytest.raises(ValueError):
+        Config(id=0, max_snapshot_bytes=1, max_snapshot_chunk_bytes=2)
+
+
+# -- the certified-above-window trigger and the lag gauge --------------------
+
+
+def _tracker():
+    persisted = Persisted()
+    persisted.add_c_entry(
+        pb.CEntry(
+            seq_no=0,
+            checkpoint_value=b"genesis",
+            network_state=_network_state(),
+        )
+    )
+    my = pb.InitialParameters(id=0, buffer_size=1 << 20)
+    tracker = CheckpointTracker(persisted, NodeBuffers(my), my)
+    tracker.reinitialize()
+    return tracker
+
+
+def test_certified_above_window_needs_intersection_quorum():
+    t = _tracker()
+    high = t.high_watermark()
+    seq = high + 25
+    t.step(1, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"cert")))
+    t.step(2, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"cert")))
+    # 2 < 2f+1 = 3: not yet a transfer trigger, lag gauge stays flat.
+    assert t.certified_above_window() is None
+    assert t.lag_seqnos() == 0
+    # A duplicate vote from the same node must not fake a quorum.
+    t.step(2, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"cert")))
+    assert t.certified_above_window() is None
+    t.step(3, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"cert")))
+    assert t.certified_above_window() == (seq, b"cert")
+    assert t.lag_seqnos() == seq - high
+
+
+def test_split_votes_never_certify():
+    t = _tracker()
+    seq = t.high_watermark() + 25
+    t.step(1, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"a")))
+    t.step(2, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"b")))
+    t.step(3, pb.Msg(type=pb.Checkpoint(seq_no=seq, value=b"c")))
+    assert t.certified_above_window() is None
+    assert t.lag_seqnos() == 0
+
+
+# -- the new chaos invariants ------------------------------------------------
+
+
+def test_bounded_catchup_invariant():
+    check_bounded_catchup(1000, 5000, 10_000)
+    with pytest.raises(InvariantViolation):
+        check_bounded_catchup(1000, None, 10_000)  # never caught up
+    with pytest.raises(InvariantViolation):
+        check_bounded_catchup(1000, 12_001, 10_000)  # blew the bound
+
+
+def test_transfer_corruption_invariant():
+    check_transfer_corruption_rejected(rejections=3, corrupted=5)
+    with pytest.raises(InvariantViolation):
+        check_transfer_corruption_rejected(rejections=0, corrupted=5)
+    with pytest.raises(InvariantViolation):
+        # Zero frames touched means the scenario proved nothing.
+        check_transfer_corruption_rejected(rejections=0, corrupted=0)
+
+
+# -- reconfiguration under fire (slow: real processes / real sockets) --------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_mp_join_under_partition():
+    """A fresh node process joins a loaded 5-process cluster mid-run,
+    state-transfers through a partition that splits it from part of the
+    quorum, and reaches the commit frontier within the bound — with
+    snapshot-install evidence, so the join cannot pass vacuously."""
+    from mirbft_tpu.cluster.chaos_mp import (
+        join_under_partition_scenario,
+        run_mp_scenario,
+    )
+
+    result = run_mp_scenario(
+        join_under_partition_scenario(), seed=0, budget_s=300.0
+    )
+    assert result.passed, result.violation
+    assert result.counters["snapshots_installed"] >= 1
+    assert result.counters["catchup_ms"] >= 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_mp_remove_under_partition():
+    """Removing a node while a partition isolates it must not cost the
+    survivors liveness or durable-prefix agreement."""
+    from mirbft_tpu.cluster.chaos_mp import (
+        remove_under_partition_scenario,
+        run_mp_scenario,
+    )
+
+    result = run_mp_scenario(
+        remove_under_partition_scenario(), seed=0, budget_s=300.0
+    )
+    assert result.passed, result.violation
+    assert result.counters["removed"] == 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_live_transfer_corrupt_stream_rejected_on_real_sockets():
+    """An adversary proxy corrupts/truncates SnapshotChunk frames on the
+    wire while a rebooted replica state-transfers: every touched stream
+    is refused with evidence and the transfer still completes via clean
+    donors — zero forks."""
+    from mirbft_tpu.chaos.live import run_live_scenario
+    from mirbft_tpu.chaos.scenarios import transfer_corrupt_scenario
+
+    result = run_live_scenario(
+        transfer_corrupt_scenario(), seed=0, budget_s=90.0
+    )
+    assert result.passed, result.violation
+    assert result.counters["transfer_corrupted"] > 0
+    assert result.counters["transfer_rejected"] >= 1
+    assert result.commits > 0

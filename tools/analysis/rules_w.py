@@ -1,7 +1,7 @@
-"""General defect classes W1..W16 (the original tools/lint.py checks as
+"""General defect classes W1..W17 (the original tools/lint.py checks as
 Rule objects, message-compatible, plus the seeded-randomness ban and the
-adversary-tooling, resource-introspection, and device-timing
-confinements).
+adversary-tooling, resource-introspection, device-timing, and
+snapshot-I/O confinements).
 
 The catalog (rationale per rule lives in docs/ANALYSIS.md):
 
@@ -43,6 +43,13 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   plane is its single sanctioned accelerator boundary.  A stray jnp
   import anywhere else in core/ either drags device nondeterminism into
   replayed state or silently forces host transfers on the hot path.
+- W17 snapshot file I/O (``write_snapshot_file`` / ``read_snapshot_file``
+  / ``remove_snapshot_file``) outside ``runtime/storage.py`` and
+  ``runtime/transfer.py`` — the staged-snapshot crash contract (tmp +
+  fsync + rename, resume-on-restart, WAL-independent adoption
+  authority) lives in exactly two files.  A third call site would fork
+  the atomicity/cleanup discipline and let a crash mid-transfer leave
+  state the restart path does not know how to interpret.
 """
 
 from __future__ import annotations
@@ -228,6 +235,31 @@ def in_device_timing_ban_scope(posix: str) -> bool:
         "mirbft_tpu/" in posix
         and DEVICE_TIMING_ALLOWED_FILE not in posix
         and DEVICE_TIMING_ALLOWED_TREE not in posix
+    )
+
+
+# The only two files allowed to touch staged snapshot blobs on disk:
+# storage.py owns the atomic write/read/remove primitives and
+# transfer.py is their single caller (staging verified snapshots for
+# crash-resume).  Anyone else handling the staged file would fork the
+# atomicity and cleanup discipline.
+SNAPSHOT_IO_ALLOWED_FILES = (
+    "mirbft_tpu/runtime/storage.py",
+    "mirbft_tpu/runtime/transfer.py",
+)
+
+# References to these names anywhere else in mirbft_tpu/ trip W17.
+SNAPSHOT_IO_FUNCS = (
+    "write_snapshot_file",
+    "read_snapshot_file",
+    "remove_snapshot_file",
+)
+
+
+def in_snapshot_io_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W17 bans snapshot file I/O."""
+    return "mirbft_tpu/" in posix and not any(
+        posix.endswith(allowed) for allowed in SNAPSHOT_IO_ALLOWED_FILES
     )
 
 
@@ -715,6 +747,25 @@ def _check_w16(ctx: FileContext):
                 yield Finding("W16", ctx.path, node.lineno, msg)
 
 
+def _check_w17(ctx: FileContext):
+    msg = (
+        "snapshot file I/O outside runtime/storage.py and "
+        "runtime/transfer.py (the staged-blob crash contract — atomic "
+        "write, restart resume, cleanup — lives in exactly two files; "
+        "everything else goes through the TransferEngine)"
+    )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            if any(alias.name in SNAPSHOT_IO_FUNCS for alias in node.names):
+                yield Finding("W17", ctx.path, node.lineno, msg)
+        elif isinstance(node, ast.Name):
+            if node.id in SNAPSHOT_IO_FUNCS:
+                yield Finding("W17", ctx.path, node.lineno, msg)
+        elif isinstance(node, ast.Attribute):
+            if node.attr in SNAPSHOT_IO_FUNCS:
+                yield Finding("W17", ctx.path, node.lineno, msg)
+
+
 def _as_list(gen_fn):
     def check(ctx):
         return list(gen_fn(ctx))
@@ -888,6 +939,20 @@ register(
         ),
         check=_as_list(_check_w15),
         scope=in_device_timing_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W17",
+        title="snapshot file I/O outside storage.py/transfer.py",
+        doc=(
+            "write_snapshot_file/read_snapshot_file/remove_snapshot_file "
+            "are confined to runtime/storage.py (the atomic primitives) "
+            "and runtime/transfer.py (their single caller); a third call "
+            "site would fork the staged-blob crash contract."
+        ),
+        check=_as_list(_check_w17),
+        scope=in_snapshot_io_ban_scope,
     )
 )
 register(
