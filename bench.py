@@ -141,6 +141,30 @@ LIVE_MP_STEP_DURATION_S = 2.0
 LIVE_MP_DRAIN_S = 25.0
 LIVE_MP_BATCH_SIZE = 4
 
+# App rung: the replicated KV service's user-visible read/write SLOs
+# (docs/APP.md) on an 8-process cluster — every op goes through the
+# socket service: writes pay propose → consensus → apply → waiter
+# wakeup, committed reads pay the read-index barrier plus a local state
+# read.  Sessions run closed-loop under the loadgen KV client-model mix
+# (uniform + Zipf hot-set keys, mixed payload sizes); the rung's
+# read/write p50/p95/p99 + goodput ride under the payload's
+# "loadgen_app" key so `obsv --diff` gates them run-to-run.
+APP_NODES = 8
+APP_SESSIONS = 4
+APP_OPS_PER_SESSION = 40
+# Closed-loop sessions keep at most APP_SESSIONS writes outstanding, so
+# larger batches would never fill (there is no partial-batch cut timer);
+# one request per batch measures the per-op path, not batch formation.
+APP_BATCH_SIZE = 1
+# Eight worker processes can outnumber the machine's cores; protocol
+# timeouts are tick-denominated, so a generous tick keeps CPU-starvation
+# scheduling gaps from reading as epoch suspicion (at 0.04s ticks a
+# single-core box livelocks in perpetual epoch change and commits
+# nothing).
+APP_TICK_S = 0.25
+APP_READ_RATIO = 0.5
+APP_OP_TIMEOUT_S = 20.0
+
 # Attack rung: the paper's request-duplication flood at the client seam
 # — every submission delivered (1 + copies) times to every node.  The
 # dedup tax is the goodput/p95 delta against a clean A/B baseline run in
@@ -1157,6 +1181,59 @@ def live_mp_run(kind: str):
         supervisor.teardown()
 
 
+def app_run():
+    """KV service SLO rung: APP_SESSIONS closed-loop sessions drive
+    mixed reads/writes through the replicated KV service's sockets on an
+    APP_NODES-process cluster.  Returns loadgen ``KvStepResult``s ready
+    for the SLO artifact (read/write latency split included)."""
+    from mirbft_tpu import loadgen
+    from mirbft_tpu.app.service import KvClient
+    from mirbft_tpu.cluster import ClusterSupervisor
+
+    client_ids = list(range(1, APP_SESSIONS + 1))
+    supervisor = ClusterSupervisor(
+        node_count=APP_NODES,
+        client_ids=client_ids,
+        batch_size=APP_BATCH_SIZE,
+        processor="pipelined",
+        tick_seconds=APP_TICK_S,
+        app="kv",
+    )
+    sessions: dict = {}
+    try:
+        supervisor.start()
+        # Every worker publishes its service port at boot; wait for the
+        # full mesh so session homes spread across all eight nodes.
+        deadline = time.monotonic() + 30.0
+        addresses = supervisor.app_addresses()
+        while len(addresses) < APP_NODES and time.monotonic() < deadline:
+            time.sleep(0.1)
+            addresses = supervisor.app_addresses()
+        if not addresses:
+            raise RuntimeError("no KV service endpoint was published")
+        homes = sorted(addresses)
+        sessions = {
+            cid: KvClient(addresses, cid, home=homes[i % len(homes)])
+            for i, cid in enumerate(client_ids)
+        }
+        workload = loadgen.KvWorkload(
+            sessions,
+            loadgen.kv_client_models(client_ids, read_ratio=APP_READ_RATIO),
+            seed=7,
+        )
+        return [
+            workload.run_step(
+                "app-kv-mixed",
+                ops_per_session=APP_OPS_PER_SESSION,
+                op_timeout_s=APP_OP_TIMEOUT_S,
+            )
+        ]
+    finally:
+        for session in sessions.values():
+            session.close()
+        supervisor.teardown()
+
+
 def soak_run(duration_s=None, sample_interval_s=0.5, registry=None):
     """Resource-leak soak: SOAK_NODES real Nodes over loopback TCP with
     on-disk WAL/reqstore (pipelined executor, no emulated fsync floor)
@@ -1746,6 +1823,8 @@ def main() -> int:
     if mp_pipelined is not None:
         steps, mp_pipelined_goodput, mp_pipelined_p95 = mp_pipelined
         mp_steps.extend(steps)
+    app_steps = runner.run("app_kv", app_run) or []
+    app_top = app_steps[-1] if app_steps else None
 
     def warm_calibrate():
         _enable_compile_cache()
@@ -1897,6 +1976,33 @@ def main() -> int:
             f"batch_size={LIVE_MP_BATCH_SIZE}, client mix: honest + "
             "slow/mixed-size + retry-storm"
         ),
+        # App rung: the replicated KV service's user-visible SLOs — the
+        # read/write latency split and goodput through the app sockets
+        # on an 8-process cluster; the full artifact rides under
+        # "loadgen_app" (obsv --diff flattens it to loadgen_app.step.*
+        # series and gates the split percentiles like any other *_ms).
+        "app_goodput_per_sec": _round(
+            app_top.goodput_per_sec if app_top else None
+        ),
+        "app_read_p50_ms": _round(app_top.read_p50_ms if app_top else None, 2),
+        "app_read_p95_ms": _round(app_top.read_p95_ms if app_top else None, 2),
+        "app_read_p99_ms": _round(app_top.read_p99_ms if app_top else None, 2),
+        "app_write_p50_ms": _round(
+            app_top.write_p50_ms if app_top else None, 2
+        ),
+        "app_write_p95_ms": _round(
+            app_top.write_p95_ms if app_top else None, 2
+        ),
+        "app_write_p99_ms": _round(
+            app_top.write_p99_ms if app_top else None, 2
+        ),
+        "app_config": (
+            f"{APP_NODES} worker processes with the KV service, "
+            f"{APP_SESSIONS} closed-loop sessions x "
+            f"{APP_OPS_PER_SESSION} ops, read_ratio={APP_READ_RATIO}, "
+            "uniform + Zipf keys, mixed payload sizes, committed-mode "
+            "reads (read-index barrier)"
+        ),
         "unit": "reqs/s",
         "vs_baseline": (
             round(host_wall / tpu_wall, 3) if tpu_wall and host_wall else None
@@ -2007,6 +2113,16 @@ def main() -> int:
             cluster="mp",
             nodes=LIVE_MP_NODES,
             rate_steps=list(LIVE_MP_RATE_STEPS),
+        )
+    if app_steps:
+        from mirbft_tpu import loadgen
+
+        payload["loadgen_app"] = loadgen.artifact(
+            app_steps,
+            cluster="mp-app",
+            nodes=APP_NODES,
+            sessions=APP_SESSIONS,
+            read_ratio=APP_READ_RATIO,
         )
     if plane is not None:
         payload.update(

@@ -356,3 +356,124 @@ def check_bounded_recovery(
             f"recovery took {lag}ms of simulated time after the last "
             f"disruption ended (bound: {bound_ms}ms)"
         )
+
+
+def check_linearizable_reads(history: list) -> dict:
+    """Reads over the replicated KV never go backwards or observe forks.
+
+    ``history`` is the KV workload's op record: dicts with ``client_id``,
+    ``op`` ("get"/"put"), ``key``, ``invoke_ns``/``return_ns`` wall
+    intervals, ``outcome``, ``version`` (the apply index that stamped
+    the value), and ``value`` (hex) for successful ops.
+
+    The audit is Wing&Gong-shaped but deliberately checks the decidable
+    core rather than brute-force linearization search:
+
+    - **version functionality (no forks)**: a (key, version) pair maps
+      to exactly one value across every op that observed it — two
+      different values under one version means diverged replicas.
+    - **write-version uniqueness**: versions are apply indexes, so two
+      acknowledged writes can never share a (key, version).
+    - **per-session monotonic reads**: within one client session,
+      successive reads of a key never observe a version older than a
+      version that session already observed for it.
+    - **read-your-writes**: a read issued after the same session's
+      acknowledged write to that key must observe that write's version
+      or newer (the write raises the session's version floor).
+
+    Vacuity guard: the history must contain at least one read/write
+    pair on the same key whose intervals overlap — otherwise the run
+    never exercised read/write concurrency and a pass proves nothing.
+    Returns tally evidence ``{"reads": n, "writes": n, "overlaps": n}``.
+    """
+    reads = [
+        h for h in history if h["op"] == "get" and h["outcome"] == "ok"
+    ]
+    all_reads = [h for h in history if h["op"] == "get"]
+    writes = [
+        h for h in history if h["op"] != "get" and h["outcome"] == "ok"
+    ]
+    if not all_reads or not writes:
+        raise InvariantViolation(
+            f"KV history is vacuous: {len(all_reads)} reads / "
+            f"{len(writes)} acknowledged writes"
+        )
+
+    overlaps = 0
+    writes_by_key: dict = {}
+    for w in writes:
+        writes_by_key.setdefault(w["key"], []).append(w)
+    for r in all_reads:
+        for w in writes_by_key.get(r["key"], ()):
+            if (
+                r["invoke_ns"] < w["return_ns"]
+                and w["invoke_ns"] < r["return_ns"]
+            ):
+                overlaps += 1
+                break
+    if overlaps == 0:
+        raise InvariantViolation(
+            "KV history is vacuous: no read's interval overlaps any "
+            "write to the same key"
+        )
+
+    # Version functionality: one value per (key, version), everywhere.
+    observed: dict = {}  # (key, version) -> (value_hex, who)
+    for h in writes + reads:
+        version = h.get("version", 0)
+        value = h.get("value")
+        if not version or value is None:
+            continue
+        prior = observed.get((h["key"], version))
+        if prior is None:
+            observed[(h["key"], version)] = (value, h)
+        elif prior[0] != value:
+            raise InvariantViolation(
+                f"fork: key {h['key']!r} version {version} observed as "
+                f"{prior[0]!r} (client {prior[1]['client_id']}) and "
+                f"{value!r} (client {h['client_id']})"
+            )
+
+    # Write-version uniqueness.
+    write_versions: dict = {}  # (key, version) -> write
+    for w in writes:
+        version = w.get("version", 0)
+        if not version:
+            continue
+        prior = write_versions.get((w["key"], version))
+        if prior is not None:
+            raise InvariantViolation(
+                f"two acknowledged writes share key {w['key']!r} "
+                f"version {version} (clients {prior['client_id']} "
+                f"and {w['client_id']})"
+            )
+        write_versions[(w["key"], version)] = w
+
+    # Per-session ordering: monotonic reads + read-your-writes.
+    by_session: dict = {}
+    for h in history:
+        by_session.setdefault(h["client_id"], []).append(h)
+    for client_id, ops in by_session.items():
+        ops.sort(key=lambda h: h["invoke_ns"])
+        floor: dict = {}  # key -> highest version this session observed
+        for h in ops:
+            version = h.get("version", 0)
+            if h["op"] == "get":
+                if h["outcome"] != "ok":
+                    continue
+                prior = floor.get(h["key"], 0)
+                if version < prior:
+                    raise InvariantViolation(
+                        f"session {client_id} read of {h['key']!r} went "
+                        f"backwards: observed version {version} after "
+                        f"{prior}"
+                    )
+                floor[h["key"]] = max(prior, version)
+            elif h["outcome"] == "ok" and version:
+                floor[h["key"]] = max(floor.get(h["key"], 0), version)
+
+    return {
+        "reads": len(all_reads),
+        "writes": len(writes),
+        "overlaps": overlaps,
+    }

@@ -44,8 +44,6 @@ same thing under both engines.
 
 from __future__ import annotations
 
-import hashlib
-import json
 import os
 import random
 import shutil
@@ -66,7 +64,6 @@ from ..runtime import (
     build_processor,
 )
 from ..runtime.node import NodeStopped, standard_initial_network_state
-from ..runtime.processor import Log
 from ..runtime.transfer import _KIND_CHUNK, TransferEngine
 from ..runtime.transport import (
     _HELLO_SRC,
@@ -379,99 +376,10 @@ class AdversaryProxy(PartitionProxy):
         return bytes(out)
 
 
-class DurableChainLog(Log):
-    """The runtime application under chaos: a hash-chain Log whose every
-    apply is fsynced to an append-only JSONL file — the live analogue of
-    the testengine's per-node NodeState evidence, and the ground truth
-    for the no-fork / durable-prefix audits.
-
-    WAL replay after a restart re-delivers committed entries; applies at
-    or below the last durable seq_no are skipped, so the on-disk log (and
-    the exactly-once audit reading it) never records a replay twice.
-    State-transfer adoption is its own record kind: the chain jumps, and
-    the skipped range stays absent (adopted, not individually committed).
-    """
-
-    def __init__(
-        self, path: str, node_id: int, on_commit=None, timestamps=False
-    ):
-        self.path = path
-        self.node_id = node_id
-        self.on_commit = on_commit
-        # Stamp apply records with monotonic ns (CLOCK_MONOTONIC is
-        # system-wide on one host, so a loadgen process on the same
-        # machine computes submit→commit latency by subtraction).
-        self.timestamps = timestamps
-        self.chain = b""
-        self.commits: list = []  # [(client_id, req_no, seq_no)]
-        self.last_seq = 0
-        if os.path.exists(path):
-            self._load()
-        self._file = open(path, "ab")
-
-    def _load(self) -> None:
-        with open(self.path, "rb") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    break  # torn tail write from a crash: ignore it
-                self.chain = bytes.fromhex(rec["chain"])
-                self.last_seq = rec["seq"]
-                if rec["t"] == "apply":
-                    for client_id, req_no, _digest in rec["reqs"]:
-                        self.commits.append((client_id, req_no, rec["seq"]))
-
-    def _record(self, rec: dict) -> None:
-        self._file.write(json.dumps(rec).encode() + b"\n")
-        self._file.flush()
-        os.fsync(self._file.fileno())
-
-    def apply(self, q_entry: pb.QEntry) -> None:
-        if q_entry.seq_no <= self.last_seq:
-            return  # WAL replay of an already-durable entry
-        reqs = []
-        for ack in q_entry.requests:
-            h = hashlib.sha256()
-            h.update(self.chain)
-            h.update(ack.digest)
-            self.chain = h.digest()
-            self.commits.append((ack.client_id, ack.req_no, q_entry.seq_no))
-            reqs.append((ack.client_id, ack.req_no, ack.digest.hex()))
-        self.last_seq = q_entry.seq_no
-        rec = {
-            "t": "apply",
-            "seq": q_entry.seq_no,
-            "reqs": reqs,
-            "chain": self.chain.hex(),
-        }
-        if self.timestamps:
-            rec["ts_ns"] = time.monotonic_ns()
-        self._record(rec)
-        if reqs and self.on_commit is not None:
-            self.on_commit(self.node_id, len(reqs))
-
-    def adopt(self, value: bytes, seq_no: int) -> None:
-        """State transfer: adopt a peer's checkpointed app state."""
-        self.chain = value
-        if seq_no > self.last_seq:
-            self.last_seq = seq_no
-        self._record({"t": "adopt", "seq": seq_no, "chain": value.hex()})
-
-    def snap(self, network_config, clients_state) -> bytes:
-        return self.chain
-
-    def close(self) -> None:
-        self._file.close()
-
-    def crash(self) -> None:
-        # Every apply already fsynced, so a crash loses nothing here; the
-        # distinction matters for the WAL/reqstore, whose sync cadence is
-        # the runtime's.
-        self._file.close()
+# DurableChainLog moved to mirbft_tpu/app/journal.py when the real
+# application layer landed (it is the app's durable journal, not a chaos
+# artifact); re-exported here so existing imports keep working.
+from ..app.journal import DurableChainLog  # noqa: E402,F401
 
 
 class _TransportDuct:

@@ -36,6 +36,7 @@ import time
 from types import SimpleNamespace
 
 from .. import pb
+from ..app.service import KvClient
 from ..chaos.invariants import (
     CrashSnapshot,
     InvariantViolation,
@@ -43,6 +44,7 @@ from ..chaos.invariants import (
     check_bounded_recovery,
     check_commit_resumption,
     check_durable_prefix,
+    check_linearizable_reads,
     check_no_fork,
 )
 from ..chaos.live import MIN_RECOVERY_BOUND_MS, SIM_TICK_MS
@@ -60,6 +62,34 @@ from .worker import read_json
 # The mp acceptance pair: a true kill -9 + restart-from-disk, and a
 # proxied minority partition with heal — plus the dedup storm.
 MP_SMOKE_NAMES = ("crash-restart", "partition-minority")
+
+# The KV-app chaos pair: the same two disruption families with the
+# replicated KV state machine installed and live client sessions
+# recording an op history that check_linearizable_reads audits.
+KV_MP_SMOKE_NAMES = ("kv-crash-restart", "kv-partition-minority")
+
+
+def kv_mp_matrix() -> list:
+    """Crash-restart and partition-minority with the KV app installed:
+    ``notes={"app": "kv"}`` makes the driver spawn KV client sessions
+    whose recorded read/write history is audited for linearizable
+    reads after convergence (docs/APP.md)."""
+    base = {s.name: s for s in live_smoke_matrix()}
+    out = []
+    for name in MP_SMOKE_NAMES:
+        src = base[name]
+        out.append(
+            Scenario(
+                name=f"kv-{name}",
+                description=f"{src.description} — with the replicated KV "
+                "app and linearizable-read audit",
+                partitions=src.partitions,
+                crashes=src.crashes,
+                notes={"app": "kv", "kv_sessions": 2, "kv_ops": 24},
+                tags=("kv",) + tuple(src.tags),
+            )
+        )
+    return out
 
 
 def retry_storm_scenario() -> Scenario:
@@ -229,15 +259,29 @@ class _MpDriver:
         )
         self.clients = list(range(1, scenario.client_count + 1))
         self.retry_period_s = retry_period_s
+        # KV-app mode (notes={"app": "kv"}): live client sessions drive
+        # the replicated KV service alongside the raw proposer load and
+        # record the op history check_linearizable_reads audits.  KV
+        # sessions get consensus client ids above the raw clients'.
+        self.app = scenario.notes.get("app")
+        self.kv_ops = int(scenario.notes.get("kv_ops", 24))
+        kv_sessions = (
+            int(scenario.notes.get("kv_sessions", 2))
+            if self.app == "kv"
+            else 0
+        )
+        kv_base = max(self.clients, default=0) + 1
+        self.kv_client_ids = list(range(kv_base, kv_base + kv_sessions))
         self.supervisor = ClusterSupervisor(
             node_count=scenario.node_count,
-            client_ids=self.clients,
+            client_ids=self.clients + self.kv_client_ids,
             batch_size=scenario.batch_size,
             processor=processor,
             tick_seconds=tick_seconds,
             proxied=bool(scenario.partitions),
             deferred_nodes=tuple(j.node for j in scenario.joins),
             checkpoint_interval=scenario.notes.get("checkpoint_interval"),
+            app=self.app,
         )
         self.expected = {
             (client_id, req_no)
@@ -261,6 +305,10 @@ class _MpDriver:
         self.resubmissions = 0
         self._proposer_stop = threading.Event()
         self._proposer = None
+        self.kv_history: list = []
+        self._kv_stop = threading.Event()
+        self._kv_done = threading.Event()
+        self._kv_thread = None
 
     # -- time ----------------------------------------------------------------
 
@@ -328,6 +376,95 @@ class _MpDriver:
             for client_id, req_no in ordered:
                 if (client_id, req_no) not in committed:
                     self._submit(client_id, req_no, first=False)
+
+    # -- KV app sessions -----------------------------------------------------
+
+    def _drive_kv(self) -> None:
+        """Drive the KV service with live sessions through the whole run:
+        per-session threads alternate puts and committed-mode gets over a
+        small shared key space (so read/write intervals overlap — the
+        checker's vacuity guard), refreshing service addresses every op
+        round so restarted workers' re-bound ports are picked up."""
+        addresses: dict = {}
+        while not self._kv_stop.is_set():
+            addresses = self.supervisor.app_addresses()
+            if addresses:
+                break
+            time.sleep(0.05)
+        if not addresses:
+            self._kv_done.set()
+            return
+        homes = sorted(addresses)
+        lock = threading.Lock()
+        # Lockstep rounds: a disruption can stall one session for whole
+        # seconds while its peer races ahead, desyncing the parities
+        # below until no read interval overlaps any write.  The barrier
+        # keeps every round's read and write concurrent by construction.
+        barrier = threading.Barrier(len(self.kv_client_ids))
+
+        def drive(index: int, client_id: int) -> None:
+            session = KvClient(
+                addresses, client_id, home=homes[index % len(homes)]
+            )
+            synced = True
+            try:
+                for op_no in range(self.kv_ops):
+                    if synced:
+                        try:
+                            barrier.wait(timeout=20.0)
+                        except threading.BrokenBarrierError:
+                            synced = False  # a peer exited; run free
+                    if self._kv_stop.is_set():
+                        return
+                    session.set_addresses(self.supervisor.app_addresses())
+                    key = f"k{op_no % 4}"
+                    # Opposite parities per session: at each op round one
+                    # session writes the key the other is reading.
+                    is_read = (op_no + index) % 2 == 1
+                    value = b"%d:%d" % (client_id, op_no)
+                    t0 = time.monotonic_ns()
+                    try:
+                        if is_read:
+                            resp = session.get(key, timeout=3.0)
+                        else:
+                            resp = session.put(key, value, timeout=5.0)
+                    except OSError:
+                        resp = {"status": "error"}
+                    t1 = time.monotonic_ns()
+                    entry = {
+                        "client_id": client_id,
+                        "op": "get" if is_read else "put",
+                        "key": key,
+                        "invoke_ns": t0,
+                        "return_ns": t1,
+                        "outcome": resp.get("status", "error"),
+                        "version": resp.get("version", 0),
+                    }
+                    if is_read:
+                        if resp.get("status") == "ok":
+                            entry["value"] = resp.get("value")
+                    else:
+                        entry["value"] = value.hex()
+                    with lock:
+                        self.kv_history.append(entry)
+            finally:
+                barrier.abort()  # never strand a peer at the barrier
+                session.close()
+
+        threads = [
+            threading.Thread(
+                target=drive,
+                args=(index, client_id),
+                name=f"chaos-kv-{client_id}",
+                daemon=True,
+            )
+            for index, client_id in enumerate(self.kv_client_ids)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        self._kv_done.set()
 
     # -- fault schedule ------------------------------------------------------
 
@@ -455,6 +592,11 @@ class _MpDriver:
             daemon=True,
         )
         self._proposer.start()
+        if self.kv_client_ids:
+            self._kv_thread = threading.Thread(
+                target=self._drive_kv, name="chaos-mp-kv", daemon=True
+            )
+            self._kv_thread.start()
         deadline = self._start + self.budget_s
         while time.monotonic() < deadline:
             now_s = time.monotonic() - self._start
@@ -521,8 +663,11 @@ class _MpDriver:
 
     def teardown(self) -> None:
         self._proposer_stop.set()
+        self._kv_stop.set()
         if self._proposer is not None and self._proposer.ident is not None:
             self._proposer.join(timeout=10)
+        if self._kv_thread is not None and self._kv_thread.ident is not None:
+            self._kv_thread.join(timeout=15)
         self.supervisor.teardown()
 
 
@@ -596,6 +741,22 @@ def run_mp_scenario(
                         "without installing a snapshot (vacuous join "
                         f"scenario; engine counters: {counters})"
                     )
+            if scenario.notes.get("app") == "kv":
+                # The user-visible claim: reads through the KV service
+                # never go backwards or observe forks, even across the
+                # injected crash/partition (vacuity-guarded inside).
+                # KV op budgets are deliberately NOT part of convergence
+                # (they would inflate the recovery clock); the cluster is
+                # still up here, so let the sessions finish first.
+                if not driver._kv_done.wait(timeout=60.0):
+                    raise InvariantViolation(
+                        "KV sessions failed to finish their op budget "
+                        "within 60s of consensus convergence"
+                    )
+                tally = check_linearizable_reads(driver.kv_history)
+                result.counters["kv_reads"] = tally["reads"]
+                result.counters["kv_writes"] = tally["writes"]
+                result.counters["kv_overlaps"] = tally["overlaps"]
             if scenario.removes:
                 result.counters["removed"] = len(scenario.removes)
             if driver.flood_specs:

@@ -77,6 +77,7 @@ class _NodeHandle:
         self.log_file = None
         self.transport_port = 0
         self.metrics_port = 0
+        self.app_port = 0  # KV service port (app mode only)
         # app.log tail state (poll_commits)
         self.log_offset = 0
         self.log_remainder = b""
@@ -117,6 +118,7 @@ class ClusterSupervisor:
         keep_root: bool = False,
         deferred_nodes=(),
         checkpoint_interval: int | None = None,
+        app: str | None = None,
     ):
         if profile not in WAN_PROFILES:
             raise ValueError(
@@ -169,6 +171,7 @@ class ClusterSupervisor:
             else None
         )
         self.checkpoint_interval = checkpoint_interval
+        self.app = app  # "kv" installs the replicated KV service per node
         self._booted: set = set()  # ids with a known transport address
         # Guards the client transport handle: submit() runs on load
         # generator threads while teardown() runs on the driver thread,
@@ -206,6 +209,8 @@ class ClusterSupervisor:
             spec["initial_leaders"] = self._boot_leaders
         if self.checkpoint_interval is not None:
             spec["checkpoint_interval"] = int(self.checkpoint_interval)
+        if self.app is not None:
+            spec["app"] = self.app
         return spec
 
     def _spawn(self, handle: _NodeHandle) -> None:
@@ -245,6 +250,7 @@ class ClusterSupervisor:
             if doc is not None:
                 handle.transport_port = int(doc["transport_port"])
                 handle.metrics_port = int(doc["metrics_port"])
+                handle.app_port = int(doc.get("app_port", 0))
                 return
             if not handle.alive:
                 raise WorkerDied(
@@ -470,6 +476,16 @@ class ClusterSupervisor:
 
     def alive_nodes(self) -> list:
         return [h.node_id for h in self.nodes if h.alive]
+
+    def app_addresses(self) -> dict:
+        """KV service endpoints: node_id -> (host, port) for every booted
+        node with a service (requires ``app="kv"``).  Re-read after a
+        restart — workers re-bind an ephemeral service port."""
+        out = {}
+        for handle in self.nodes:
+            if handle.alive and handle.app_port:
+                out[handle.node_id] = ("127.0.0.1", handle.app_port)
+        return out
 
     @property
     def node_ids(self) -> list:

@@ -55,7 +55,8 @@ import threading
 import time
 
 from .. import pb
-from ..chaos.live import DurableChainLog, _TransportDuct
+from ..app import AppLog, DurableChainLog, KvFrontend, KvService, KvStore
+from ..chaos.live import _TransportDuct
 from ..obsv import hooks
 from ..obsv.metrics import Registry
 from ..obsv.recorder import FlightRecorder
@@ -123,11 +124,20 @@ class Worker:
             registry=registry,
         )
         hooks.enable(registry=registry, trace=False, recorder=self.recorder)
-        self.app_log = DurableChainLog(
-            os.path.join(self.dir, "app.log"), self.node_id, timestamps=True
-        )
         self.wal = FileWal(os.path.join(self.dir, "wal"))
         self.reqstore = FileRequestStore(os.path.join(self.dir, "reqs"))
+        # The KV app (spec "app": "kv") layers the commit stream + state
+        # machine over the durable journal; journal payload mode makes
+        # the journal the restart replay source for the state machine.
+        self.app_kind = spec.get("app")
+        self._journal = DurableChainLog(
+            os.path.join(self.dir, "app.log"),
+            self.node_id,
+            timestamps=True,
+            data_source=(
+                self.reqstore.get if self.app_kind == "kv" else None
+            ),
+        )
         self.sampler = ResourceSampler(
             registry=registry,
             recorder=self.recorder,
@@ -169,6 +179,24 @@ class Worker:
             )
         else:
             self.node = Node.restart(config, self.wal, self.reqstore)
+        self.app_stream = None
+        self.kv_service = None
+        if self.app_kind == "kv":
+            self.kv_store = KvStore()
+            self.app_stream = self.node.attach_app(
+                self.kv_store,
+                state_path=os.path.join(self.dir, "app.state"),
+                queue_depth=int(spec.get("app_queue_depth", 256)),
+                data_source=self.reqstore.get,
+            )
+            # Composition replays journaled ops above the persisted
+            # snapshot floor into the state machine.
+            self.app_log = AppLog(self._journal, self.app_stream)
+            self.kv_service = KvService(
+                KvFrontend(self.app_stream, self.kv_store, self.node.propose)
+            )
+        else:
+            self.app_log = self._journal
         # Not ready until the peer mesh is dialed (phase 2 below).
         self.node.set_ready(False)
         self.transport = self._bind(int(spec.get("transport_port", 0)))
@@ -216,14 +244,14 @@ class Worker:
                 time.sleep(0.02)
 
     def announce(self) -> None:
-        write_json_atomic(
-            os.path.join(self.dir, "address.json"),
-            {
-                "pid": os.getpid(),
-                "transport_port": self.transport.address[1],
-                "metrics_port": self.node.metrics_address[1],
-            },
-        )
+        doc = {
+            "pid": os.getpid(),
+            "transport_port": self.transport.address[1],
+            "metrics_port": self.node.metrics_address[1],
+        }
+        if self.kv_service is not None:
+            doc["app_port"] = self.kv_service.port
+        write_json_atomic(os.path.join(self.dir, "address.json"), doc)
 
     def wire(self) -> None:
         """Phase 2: wait for peers.json, dial the mesh, apply the link
@@ -326,15 +354,33 @@ class Worker:
                     requests.append((ack, data))
 
             self.reqstore.uncommitted(_collect)
+            if self.app_stream is not None:
+                # The certified value binds the full app-state blob; ship
+                # the blob so an installer can verify + adopt the whole
+                # state machine, not just the chain.
+                app_bytes = (
+                    self.app_stream.snapshot_blob(cr.value)
+                    or self.app_stream.last_snapshot_blob
+                    or b""
+                )
+            else:
+                app_bytes = self.app_log.chain
             self.engine.note_checkpoint(
-                seq_no, cr.value, state, self.app_log.chain, requests
+                seq_no, cr.value, state, app_bytes, requests
             )
 
     def _install_snapshot(self, snap):
-        """TransferEngine install callback: adopt the app chain (an
-        fsynced adopt record) and the donor's uncommitted-request slice,
-        then let the node persist the checkpoint CEntry."""
-        self.app_log.adopt(snap.value, snap.seq_no)
+        """TransferEngine install callback: adopt the app state (an
+        fsynced adopt record; in KV mode the verified full state blob)
+        and the donor's uncommitted-request slice, then let the node
+        persist the checkpoint CEntry."""
+        if self.app_stream is not None:
+            if not self.app_log.install(
+                snap.app_bytes, snap.value, snap.seq_no
+            ):
+                return None  # blob does not bind to the certified value
+        else:
+            self.app_log.adopt(snap.value, snap.seq_no)
         for ack, data in snap.requests:
             self.reqstore.store(ack, data)
         self.reqstore.sync()
@@ -347,6 +393,11 @@ class Worker:
             write_json_atomic(
                 os.path.join(self.dir, "transfer.json"), self.engine.status()
             )
+            if self.app_stream is not None:
+                write_json_atomic(
+                    os.path.join(self.dir, "app.json"),
+                    self.app_stream.status(),
+                )
         except OSError:
             pass  # monitoring is best-effort; never kill the consumer
 
@@ -401,6 +452,8 @@ class Worker:
 
     def _shutdown(self, graceful: bool) -> None:
         self.sampler.stop()
+        if self.kv_service is not None:
+            self.kv_service.close()
         try:
             self.recorder.record_note(
                 "worker.shutdown", args={"graceful": graceful}

@@ -183,6 +183,28 @@ class CommitState:
         actions.state_transfer = self.transfer_target
         return actions
 
+    def retarget_transfer(self, seq_no: int, value: bytes) -> Actions:
+        """Chase a newer certified checkpoint after the in-flight target
+        failed.  A failed fetch usually means every donor GC'd the target
+        because the network moved on; retrying the dead target forever
+        wedges the node (observed as a replica stuck at seq 0 while the
+        frontier runs away).  The caller passes the newest
+        intersection-quorum-certified checkpoint — the same adoption
+        authority the ordinary lag trigger uses — so jumping is safe."""
+        if not self.transferring or self.transfer_target is None:
+            raise AssertionError("no transfer in flight to retarget")
+        if seq_no <= self.transfer_target.seq_no:
+            raise AssertionError(
+                f"retarget {seq_no} not beyond current target "
+                f"{self.transfer_target.seq_no}"
+            )
+        self.transfer_target = StateTarget(seq_no=seq_no, value=value)
+        actions = self.persisted.add_t_entry(
+            pb.TEntry(seq_no=seq_no, value=value)
+        )
+        actions.state_transfer = self.transfer_target
+        return actions
+
     # -- checkpoint results --------------------------------------------------
 
     def apply_checkpoint_result(

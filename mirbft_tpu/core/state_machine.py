@@ -287,11 +287,29 @@ class StateMachine:
                         "transfer event without a requested transfer"
                     )
                 if inner.c_entry.network_state is None:
-                    # Transfer failed (target GC'd everywhere); retry the
-                    # newest target.  (The reference would trip addCEntry's
-                    # network-state assertion here, state_machine.go:211-217
-                    # with mirbft.go:446-459.)
-                    actions.concat(self.commit_state.retry_transfer())
+                    # Transfer failed — usually because every donor GC'd
+                    # the target while the network moved on.  If an
+                    # intersection quorum has since certified a newer
+                    # checkpoint, chase that instead: retrying the dead
+                    # target forever wedges the node, since the ordinary
+                    # lag trigger (_maybe_request_transfer) stands down
+                    # while a transfer is in flight.  (The reference would
+                    # trip addCEntry's network-state assertion here,
+                    # state_machine.go:211-217 with mirbft.go:446-459.)
+                    certified = (
+                        self.checkpoint_tracker.certified_above_window()
+                    )
+                    target = self.commit_state.transfer_target
+                    if (
+                        certified is not None
+                        and target is not None
+                        and certified[0] > target.seq_no
+                    ):
+                        actions.concat(
+                            self.commit_state.retarget_transfer(*certified)
+                        )
+                    else:
+                        actions.concat(self.commit_state.retry_transfer())
                 else:
                     actions.concat(self.persisted.add_c_entry(inner.c_entry))
                     actions.concat(self._reinitialize())

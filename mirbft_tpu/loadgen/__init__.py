@@ -15,6 +15,9 @@
   ``obsv --diff`` consumes the artifact directly for the relative gate.
 - ``inproc``: the no-sockets, no-fsync in-process backend for fast
   tests.
+- ``kv``: ``KvWorkload`` drives the replicated KV service's own API
+  (mixed reads/writes per ClientModel) and reports the user-visible
+  read/write latency split (docs/APP.md).
 """
 
 from .arrivals import (  # noqa: F401
@@ -22,9 +25,14 @@ from .arrivals import (  # noqa: F401
     DiurnalArrivals,
     PoissonArrivals,
 )
-from .clients import ClientModel, standard_client_models  # noqa: F401
+from .clients import (  # noqa: F401
+    ClientModel,
+    kv_client_models,
+    standard_client_models,
+)
 from .generator import LoadGenerator, StepResult, percentile_ms  # noqa: F401
 from .inproc import InProcessCluster  # noqa: F401
+from .kv import KvStepResult, KvWorkload  # noqa: F401
 from .slo import (  # noqa: F401
     SCHEMA,
     artifact,

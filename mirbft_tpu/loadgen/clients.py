@@ -30,6 +30,14 @@ class ClientModel:
     # cluster); None disables retries.
     retry_timeout_s: float | None = None
     retry_fanout: int = 1
+    # KV workload shape (consumed by the app-rung driver; the raw-bytes
+    # generator ignores these).  read_ratio is the probability an op is a
+    # read; key_space keys named k0..k{n-1}; key_dist picks which —
+    # "uniform", or "zipf" with exponent zipf_s (rank-1 hottest).
+    read_ratio: float = 0.0
+    key_space: int = 64
+    key_dist: str = "uniform"
+    zipf_s: float = 1.1
 
     def __post_init__(self):
         if self.payload_bytes <= 0:
@@ -40,6 +48,33 @@ class ClientModel:
             raise ValueError("retry_timeout_s must be positive")
         if self.retry_fanout < 1:
             raise ValueError("retry_fanout must be >= 1")
+        if not 0.0 <= self.read_ratio <= 1.0:
+            raise ValueError("read_ratio must be in [0, 1]")
+        if self.key_space < 1:
+            raise ValueError("key_space must be >= 1")
+        if self.key_dist not in ("uniform", "zipf"):
+            raise ValueError("key_dist must be 'uniform' or 'zipf'")
+        if self.zipf_s <= 0:
+            raise ValueError("zipf_s must be positive")
+
+    def is_read(self, rng: random.Random) -> bool:
+        return self.read_ratio > 0.0 and rng.random() < self.read_ratio
+
+    def key(self, rng: random.Random) -> str:
+        """Draw a key per key_dist.  The zipf draw is the standard
+        inverse-CDF over harmonic weights, precomputed once per model."""
+        if self.key_dist == "uniform" or self.key_space == 1:
+            return f"k{rng.randrange(self.key_space)}"
+        cdf = _zipf_cdf(self.key_space, self.zipf_s)
+        point = rng.random()
+        lo, hi = 0, len(cdf) - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if cdf[mid] < point:
+                lo = mid + 1
+            else:
+                hi = mid
+        return f"k{lo}"
 
     def payload(self, rng: random.Random, req_no: int) -> bytes:
         size = (
@@ -54,6 +89,24 @@ class ClientModel:
         return (stamp + b"x" * size)[: max(size, len(stamp))]
 
 
+_ZIPF_CDFS: dict = {}
+
+
+def _zipf_cdf(n: int, s: float) -> list:
+    cdf = _ZIPF_CDFS.get((n, s))
+    if cdf is None:
+        weights = [1.0 / (rank**s) for rank in range(1, n + 1)]
+        total = sum(weights)
+        acc = 0.0
+        cdf = []
+        for w in weights:
+            acc += w / total
+            cdf.append(acc)
+        cdf[-1] = 1.0
+        _ZIPF_CDFS[(n, s)] = cdf
+    return cdf
+
+
 # The mix exercised by the bench rung: one honest client, one slow
 # client with mixed payload sizes, one retry-stormer.
 def standard_client_models(client_ids) -> dict:
@@ -62,6 +115,26 @@ def standard_client_models(client_ids) -> dict:
         ClientModel(),
         ClientModel(payload_choices=(16, 256, 1024), submit_lag_s=0.05),
         ClientModel(retry_timeout_s=1.0, retry_fanout=2),
+    )
+    return {
+        client_id: models[i % len(models)]
+        for i, client_id in enumerate(client_ids)
+    }
+
+
+def kv_client_models(client_ids, read_ratio: float = 0.5) -> dict:
+    """The app-rung mix: every client reads and writes; payload sizes
+    alternate between small-value and mixed, key distributions between
+    uniform and a Zipf hot set (the skew PAPER.md's bucket rotation is
+    supposed to absorb)."""
+    models = (
+        ClientModel(read_ratio=read_ratio, key_space=64),
+        ClientModel(
+            read_ratio=read_ratio,
+            key_space=64,
+            key_dist="zipf",
+            payload_choices=(16, 256, 1024),
+        ),
     )
     return {
         client_id: models[i % len(models)]

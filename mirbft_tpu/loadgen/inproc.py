@@ -23,6 +23,9 @@ import threading
 import time
 
 from .. import pb
+from ..app import KvFrontend, KvStore
+from ..app import kvstore as kv_ops
+from ..app.stream import CommitStream
 from ..runtime import Config, Node, build_processor
 from ..runtime.node import NodeStopped, standard_initial_network_state
 from ..runtime.processor import Link, Log
@@ -145,6 +148,110 @@ class MemChainLog(Log):
         return self.chain
 
 
+class _MemAppLog(Log):
+    """KV mode: the chain log (commit stamps for the generator) composed
+    with the commit stream — the in-process analogue of ``app.AppLog``
+    without the durable journal."""
+
+    def __init__(self, chain_log: MemChainLog, stream: CommitStream):
+        self.chain_log = chain_log
+        self.stream = stream
+        stream.chain_source = lambda: chain_log.chain
+
+    @property
+    def chain(self) -> bytes:
+        return self.chain_log.chain
+
+    def apply(self, q_entry: pb.QEntry) -> None:
+        self.chain_log.apply(q_entry)
+        self.stream.apply(q_entry)
+
+    def snap(self, network_config, clients_state) -> bytes:
+        self.chain_log.snap(network_config, clients_state)
+        return self.stream.snap(network_config, clients_state)
+
+    def install(self, app_bytes: bytes, value: bytes, seq_no: int) -> bool:
+        chain = CommitStream.chain_of(app_bytes)
+        if chain is None or not self.stream.install(app_bytes, value, seq_no):
+            return False
+        self.chain_log.adopt(chain, seq_no)
+        return True
+
+
+class KvSession:
+    """An in-process KV session over the frontends: the loopback
+    equivalent of ``app.service.KvClient`` (same write broadcast and
+    read-barrier semantics, direct calls instead of sockets)."""
+
+    def __init__(self, cluster: "InProcessCluster", client_id: int,
+                 home: int = 0):
+        self.cluster = cluster
+        self.client_id = client_id
+        self.home = home
+        self.req_no = 0
+        self.session_index = 0
+
+    def _observe(self, resp: dict) -> dict:
+        for field in ("index", "version", "frontier"):
+            val = resp.get(field)
+            if isinstance(val, int) and val > self.session_index:
+                self.session_index = val
+        return resp
+
+    def _write(self, data: bytes, timeout: float) -> dict:
+        # Client windows open at req_no 0 and advance in order.
+        req_no = self.req_no
+        self.req_no += 1
+        stream = self.cluster.replicas[self.home].stream
+        waiter = stream.register_waiter(self.client_id, req_no)
+        request = pb.Request(
+            client_id=self.client_id, req_no=req_no, data=data
+        )
+        # The Mir client contract: broadcast the write to every node.
+        for node_id in self.cluster.node_ids:
+            self.cluster.submit(node_id, request)
+        got = waiter.wait(timeout)
+        if got is None:
+            stream.cancel_waiter(self.client_id, req_no)
+            return {"status": "timeout"}
+        index, result = got
+        return self._observe(
+            {
+                "status": (result or {}).get("outcome", "ok"),
+                "version": (result or {}).get("version", index),
+                "index": index,
+            }
+        )
+
+    def put(self, key: str, value: bytes, timeout: float = 10.0) -> dict:
+        return self._write(kv_ops.encode_put(key, value), timeout)
+
+    def delete(self, key: str, timeout: float = 10.0) -> dict:
+        return self._write(kv_ops.encode_delete(key), timeout)
+
+    def cas(self, key: str, expect_version: int, value: bytes,
+            timeout: float = 10.0) -> dict:
+        return self._write(
+            kv_ops.encode_cas(key, expect_version, value), timeout
+        )
+
+    def get(self, key: str, mode: str = "committed",
+            timeout: float = 10.0) -> dict:
+        frontend = self.cluster.replicas[self.home].frontend
+        resp = frontend.execute(
+            {
+                "op": "get",
+                "key": key,
+                "mode": mode,
+                "min_index": self.session_index if mode == "committed" else 0,
+                "timeout": timeout,
+            }
+        )
+        if resp.get("status") in ("ok", "not_found"):
+            self._observe(resp)
+        return resp
+
+
 class _DirectLink(Link):
     """Same-process message passing: send == dest.step(source, msg)."""
 
@@ -189,6 +296,19 @@ class _InProcReplica:
             processor=processor,
         )
         self.node = Node.start_new(config, initial_state)
+        self.stream = None
+        self.frontend = None
+        if cluster.app == "kv":
+            self.store = KvStore()
+            self.stream = self.node.attach_app(
+                self.store,
+                queue_depth=cluster.app_queue_depth,
+                data_source=self.reqstore.get,
+            )
+            self.app_log = _MemAppLog(self.app_log, self.stream)
+            self.frontend = KvFrontend(
+                self.stream, self.store, self.node.propose
+            )
         self.processor = build_processor(
             self.node,
             _DirectLink(cluster, node_id),
@@ -230,19 +350,34 @@ class _InProcReplica:
             self.reqstore.uncommitted(
                 lambda ack, data: requests.append((ack, data))
             )
+            if self.stream is not None:
+                app_bytes = (
+                    self.stream.snapshot_blob(cr.value)
+                    or self.stream.last_snapshot_blob
+                    or b""
+                )
+            else:
+                app_bytes = self.app_log.chain
             self.engine.note_checkpoint(
                 cr.checkpoint.seq_no,
                 cr.value,
                 network_state,
-                self.app_log.chain,
+                app_bytes,
                 requests,
             )
 
     def _install_snapshot(self, snap):
-        """TransferEngine install callback: adopt the app chain and the
-        donor's uncommitted-request slice, then let the node persist the
+        """TransferEngine install callback: adopt the app state (in KV
+        mode the verified full state blob) and the donor's
+        uncommitted-request slice, then let the node persist the
         checkpoint CEntry."""
-        self.app_log.adopt(snap.value, snap.seq_no)
+        if self.stream is not None:
+            if not self.app_log.install(
+                snap.app_bytes, snap.value, snap.seq_no
+            ):
+                return None
+        else:
+            self.app_log.adopt(snap.value, snap.seq_no)
         for ack, data in snap.requests:
             self.reqstore.store(ack, data)
         return snap.network_state
@@ -294,9 +429,13 @@ class InProcessCluster:
         batch_size: int = 1,
         processor: str = "serial",
         tick_seconds: float = 0.02,
+        app: str | None = None,
+        app_queue_depth: int = 256,
     ):
         self.batch_size = batch_size
         self.tick_seconds = tick_seconds
+        self.app = app
+        self.app_queue_depth = app_queue_depth
         self.client_ids = list(client_ids) if client_ids else [1, 2]
         self._lock = threading.Lock()
         self._commits: list = []
@@ -327,6 +466,13 @@ class InProcessCluster:
             out = self._commits
             self._commits = []
         return out
+
+    def kv_session(self, client_id: int, home: int = 0) -> KvSession:
+        """A KV session over the in-process frontends (requires
+        ``app="kv"``); ``client_id`` must be a registered client id."""
+        if self.app != "kv":
+            raise RuntimeError("kv_session requires InProcessCluster(app='kv')")
+        return KvSession(self, client_id, home)
 
     def check(self) -> None:
         """Raise the first consumer/serializer failure, if any."""

@@ -23,28 +23,49 @@ import json
 SCHEMA = "mirbft-loadgen-slo/1"
 
 
+# Per-step read/write latency split, present only when the step object
+# carries it (the KV app rung's KvStepResult does; the raw-bytes
+# generator's StepResult does not) — consumers must treat these keys as
+# optional.
+_RW_KEYS = (
+    "reads",
+    "reads_failed",
+    "writes",
+    "read_goodput_per_sec",
+    "write_goodput_per_sec",
+    "read_p50_ms",
+    "read_p95_ms",
+    "read_p99_ms",
+    "write_p50_ms",
+    "write_p95_ms",
+    "write_p99_ms",
+)
+
+
 def artifact(steps: list, **meta) -> dict:
     """Assemble the SLO artifact from ``StepResult``s (or any objects
     with the same fields)."""
-    doc = {
-        "schema": SCHEMA,
-        "steps": [
-            {
-                "name": step.name,
-                "offered_rate_per_sec": step.offered_rate_per_sec,
-                "duration_s": step.duration_s,
-                "submitted": step.submitted,
-                "duplicates": step.duplicates,
-                "committed": step.committed,
-                "timed_out": step.timed_out,
-                "goodput_per_sec": step.goodput_per_sec,
-                "p50_ms": step.p50_ms,
-                "p95_ms": step.p95_ms,
-                "p99_ms": step.p99_ms,
-            }
-            for step in steps
-        ],
-    }
+    docs = []
+    for step in steps:
+        entry = {
+            "name": step.name,
+            "offered_rate_per_sec": step.offered_rate_per_sec,
+            "duration_s": step.duration_s,
+            "submitted": step.submitted,
+            "duplicates": step.duplicates,
+            "committed": step.committed,
+            "timed_out": step.timed_out,
+            "goodput_per_sec": step.goodput_per_sec,
+            "p50_ms": step.p50_ms,
+            "p95_ms": step.p95_ms,
+            "p99_ms": step.p99_ms,
+        }
+        for key in _RW_KEYS:
+            value = getattr(step, key, None)
+            if value is not None:
+                entry[key] = value
+        docs.append(entry)
+    doc = {"schema": SCHEMA, "steps": docs}
     if meta:
         doc["meta"] = dict(meta)
     return doc

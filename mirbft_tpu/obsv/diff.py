@@ -88,6 +88,18 @@ def _loadgen_series(doc, prefix=""):
             ("committed", "committed_reqs"),
             ("duplicates", "duplicates"),
             ("timed_out", "timed_out"),
+            # KV app-rung splits (present only in app workload artifacts);
+            # the *_ms / goodput_per_sec suffixes reuse the existing
+            # direction tokens, so these gate without new rules.
+            ("read_p50_ms", "read_p50_ms"),
+            ("read_p95_ms", "read_p95_ms"),
+            ("read_p99_ms", "read_p99_ms"),
+            ("write_p50_ms", "write_p50_ms"),
+            ("write_p95_ms", "write_p95_ms"),
+            ("write_p99_ms", "write_p99_ms"),
+            ("read_goodput_per_sec", "read_goodput_per_sec"),
+            ("write_goodput_per_sec", "write_goodput_per_sec"),
+            ("reads_failed", "reads_failed"),
         ):
             value = step.get(key)
             if isinstance(value, (int, float)) and not isinstance(value, bool):
@@ -123,6 +135,9 @@ def extract_series(artifact):
     loadgen_doc = artifact.get("loadgen")
     if isinstance(loadgen_doc, dict):
         series.update(_loadgen_series(loadgen_doc, prefix="loadgen."))
+    app_doc = artifact.get("loadgen_app")
+    if isinstance(app_doc, dict):
+        series.update(_loadgen_series(app_doc, prefix="loadgen_app."))
     device = artifact.get("device")
     if isinstance(device, dict):
         for fn, n in sorted((device.get("retraces") or {}).items()):

@@ -39,6 +39,10 @@ import threading
 CATALOG = {
     "mirbft_ack_batch_size": "RequestAck frame/batch sizes entering an ack plane, by plane (host = step_ack_many frames, device = kernel flushes).",
     "mirbft_ack_events_total": "RequestAck events absorbed by an ack plane, by plane (host _FastAcks/scalar path vs device bitmask plane).",
+    "mirbft_app_applied_index": "The commit stream's applied index: ops delivered exactly-once to the registered state machine, in consensus order.",
+    "mirbft_app_read_barrier_wait_seconds": "Seconds a committed-mode read waited behind the read-index barrier (applied index covering the read's issue-point frontier).",
+    "mirbft_app_reads_total": "KV service reads, by mode (committed/stale) and outcome (ok/not_found/timeout).",
+    "mirbft_app_writes_total": "KV service writes, by mode (put/delete/cas) and outcome (ok/not_found/cas_conflict/malformed/timeout/rejected).",
     "mirbft_bench_stage_compile_seconds": "bench.py per-stage warmup/compile seconds (JAX/Mosaic compiles triggered before the timed window).",
     "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
     "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/oversized_snapshot_chunk/malformed).",
@@ -99,6 +103,10 @@ CATALOG = {
 CATALOG_LABELS = {
     "mirbft_ack_batch_size": ("plane",),
     "mirbft_ack_events_total": ("plane",),
+    "mirbft_app_applied_index": (),
+    "mirbft_app_read_barrier_wait_seconds": (),
+    "mirbft_app_reads_total": ("mode", "outcome"),
+    "mirbft_app_writes_total": ("mode", "outcome"),
     "mirbft_bench_stage_compile_seconds": ("stage",),
     "mirbft_bench_stage_seconds": ("stage",),
     "mirbft_byzantine_rejections_total": ("kind",),
@@ -170,6 +178,9 @@ CARDINALITY = {
     # must fail loudly instead of minting series.
     "mirbft_transfer_chunks_total": 8,
     "mirbft_transfer_snapshots_total": 8,
+    # 2 read modes x 3 outcomes; 3 write ops x 6 outcomes.
+    "mirbft_app_reads_total": 8,
+    "mirbft_app_writes_total": 24,
 }
 
 

@@ -135,6 +135,7 @@ class Node:
         self._waiters: list[_Waiter] = []
         self._wal_storage = wal_storage
         self._req_storage = req_storage
+        self.app_stream = None  # set by attach_app
         self._exporter = None
         if config.metrics_port is not None:
             from ..obsv.exporter import ObsvExporter
@@ -177,6 +178,33 @@ class Node:
         return cls(config, wal_storage, req_storage)
 
     # -- public API (thread-safe) --------------------------------------------
+
+    def attach_app(self, app, *, state_path=None, queue_depth=256,
+                   data_source=None):
+        """Register a replicated state machine and return the commit
+        stream: an ordered, exactly-once-per-apply-index delivery of
+        committed ops into ``app.apply(client_id, req_no, seq_no,
+        apply_index, data)``, with the applied index persisted inside the
+        app snapshot at ``state_path`` so restart and snapshot install
+        resume without re-applying.  The returned ``CommitStream`` is the
+        ``Log`` to hand to ``build_processor`` (or to compose with a
+        durable journal via ``app.AppLog``); ``app_status()`` reads its
+        frontier.  See docs/APP.md."""
+        from ..app.stream import CommitStream
+
+        self.app_stream = CommitStream(
+            app,
+            node_id=self.config.id,
+            state_path=state_path,
+            queue_depth=queue_depth,
+            data_source=data_source,
+        )
+        return self.app_stream
+
+    def app_status(self) -> dict | None:
+        """The attached commit stream's frontier/queue status (None when
+        no app is attached)."""
+        return None if self.app_stream is None else self.app_stream.status()
 
     def step(self, source: int, msg: pb.Msg) -> None:
         """Inbound authenticated message from the transport.  Structural
@@ -278,6 +306,8 @@ class Node:
                 self._inbox.put(("stop",))
             self._thread.join(timeout=10)
             self._close_exporter()
+            if self.app_stream is not None:
+                self.app_stream.close()
 
     @property
     def exit_error(self):

@@ -629,3 +629,31 @@ def remove_snapshot_file(path: str) -> None:
     except OSError:
         return
     _fsync_dir(directory)
+
+
+# -- application state (replicated app snapshots) -----------------------------
+#
+# The app commit stream (mirbft_tpu/app/stream.py) persists its state as
+# ONE atomic blob: the applied consensus seq_no, the apply index, the
+# journal chain, and the state machine's own snapshot travel together,
+# so a crash at any instant leaves either the old complete state or the
+# new complete state — never an applied-index that disagrees with the
+# entries actually absorbed (the double-apply-after-restart bug class).
+# All fsync-bearing app-state file I/O lives here (lint rules W10/W18).
+
+
+def write_app_state(path: str, blob: bytes) -> None:
+    """Atomically persist an app-state blob (tmp + fsync + rename + dir
+    fsync): the applied-index inside the blob can never be observed
+    without the state it describes."""
+    write_snapshot_file(path, blob)
+
+
+def read_app_state(path: str) -> bytes | None:
+    """Read a persisted app-state blob, or None when absent."""
+    return read_snapshot_file(path)
+
+
+def remove_app_state(path: str) -> None:
+    """Durably discard a persisted app-state blob."""
+    remove_snapshot_file(path)

@@ -328,6 +328,14 @@ class TransferEngine:
         self._total: int | None = None
         self._deadline = 0.0
         self._wait_until = 0.0
+        # Outgoing fetch requests queued under the lock, sent after it is
+        # released (_flush_outgoing).  Sending through the duct while
+        # holding the lock self-deadlocks under a synchronous duct (the
+        # loadgen's in-process duct delivers the donor's chunk response
+        # re-entrantly on the same thread, which re-enters _on_chunk and
+        # blocks on the non-reentrant lock) — and even over sockets a
+        # blocking send would stall every other engine entry point.
+        self._outgoing: list[tuple[int, bytes]] = []  # guarded-by: _lock
         self.counters = {key: 0 for key in _COUNTER_KEYS}
 
     # -- donor side ----------------------------------------------------------
@@ -409,6 +417,7 @@ class TransferEngine:
             return
         if frame[0] == "chunk":
             self._on_chunk(sender, *frame[1:])
+            self._flush_outgoing()
             return
         # NACK: the donor cannot serve this target — fail over now.
         _tag, seq_no = frame
@@ -420,6 +429,7 @@ class TransferEngine:
                 and self._current_donor() == sender
             ):
                 self._rotate_donor_locked()
+        self._flush_outgoing()
 
     def _on_chunk(
         self,
@@ -540,10 +550,11 @@ class TransferEngine:
                 actions = self._poll_ready_locked()
             elif self._phase == "failed":
                 actions = [self._fail_locked()]
+        self._flush_outgoing()
         for action in actions:
             action()
 
-    def _poll_init_locked(self) -> list:
+    def _poll_init_locked(self) -> list:  # holds: _lock
         target = self._target
         blob = (
             read_snapshot_file(self.staging_path)
@@ -567,7 +578,7 @@ class TransferEngine:
         self._send_request_locked(resume=0)
         return []
 
-    def _poll_ready_locked(self) -> list:
+    def _poll_ready_locked(self) -> list:  # holds: _lock
         target = self._target
         blob = b"".join(self._chunks)
         snap = self._verify_blob(blob, target)
@@ -622,6 +633,7 @@ class TransferEngine:
                         self._phase = "failed"
                 else:
                     self._rotate_donor_locked()
+            self._flush_outgoing()
             return
         with self._lock:
             self._phase = "idle"
@@ -650,18 +662,29 @@ class TransferEngine:
             chain_seed(target.seq_no, target.value) if target else b""
         )
 
-    def _send_request_locked(self, resume: int) -> None:
+    def _send_request_locked(self, resume: int) -> None:  # holds: _lock
         donor = self._current_donor()
         target = self._target
         self._phase = "fetching"
         self._deadline = self.clock() + self.chunk_timeout_s
         if resume == 0:
             self._reset_stream_locked()
-        self.duct.send(
-            donor, encode_request(target.seq_no, target.value, resume)
+        # Queue, don't send: the caller flushes after releasing the lock.
+        self._outgoing.append(
+            (donor, encode_request(target.seq_no, target.value, resume))
         )
 
-    def _rotate_donor_locked(self) -> None:
+    def _flush_outgoing(self) -> None:
+        """Send queued fetch requests with the lock released (see the
+        _outgoing comment in __init__)."""
+        while True:
+            with self._lock:
+                if not self._outgoing:
+                    return
+                donor, frame = self._outgoing.pop(0)
+            self.duct.send(donor, frame)
+
+    def _rotate_donor_locked(self) -> None:  # holds: _lock
         """Abandon the current donor's stream and move to the next; after
         ``donor_rounds`` full cycles, report failure to the core (which
         re-emits state_transfer, restarting the whole fetch)."""

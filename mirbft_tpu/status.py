@@ -316,6 +316,59 @@ def transfer_status(engine) -> TransferStatus:
 
 
 @dataclass
+class AppStatus:
+    """Snapshot of the app commit stream (app/stream.py): the applied
+    and enqueued frontiers, queue pressure, and read-barrier traffic —
+    the user-visible side of the node.  Also published as ``app.json``
+    by the cluster worker."""
+
+    node_id: int
+    applied_seq: int
+    applied_index: int
+    enqueued_seq: int
+    enqueued_index: int
+    queue_len: int
+    queue_depth: int
+    waiters: int
+    installs: int
+    snapshots: int
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, default=str)
+
+    def pretty(self) -> str:
+        lines = [f"=== App (node {self.node_id}) ==="]
+        lines.append(
+            f"  applied: index {self.applied_index} @ seq "
+            f"{self.applied_seq} (enqueued: index {self.enqueued_index} "
+            f"@ seq {self.enqueued_seq})"
+        )
+        lines.append(
+            f"  queue: {self.queue_len}/{self.queue_depth} "
+            f"waiters={self.waiters} installs={self.installs} "
+            f"snapshots_retained={self.snapshots}"
+        )
+        return "\n".join(lines)
+
+
+def app_status(stream, node_id: int | None = None) -> AppStatus:
+    """Snapshot an app.stream.CommitStream."""
+    snap = stream.status()
+    return AppStatus(
+        node_id=node_id if node_id is not None else stream.node_id,
+        applied_seq=snap["applied_seq"],
+        applied_index=snap["applied_index"],
+        enqueued_seq=snap["enqueued_seq"],
+        enqueued_index=snap["enqueued_index"],
+        queue_len=snap["queue_len"],
+        queue_depth=snap["queue_depth"],
+        waiters=snap["waiters"],
+        installs=snap["installs"],
+        snapshots=snap["snapshots"],
+    )
+
+
+@dataclass
 class BreakerStatus:
     state: str
     consecutive_failures: int

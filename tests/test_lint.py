@@ -577,6 +577,63 @@ def test_linter_confines_snapshot_io_to_storage_and_transfer(tmp_path):
     assert not any("W17" in line for line in lint.check_file(tests_ok))
 
 
+def test_linter_confines_app_state_io_to_storage_and_app(tmp_path):
+    """W18: app-state file I/O (write/read/remove_app_state) is confined
+    to runtime/storage.py (the atomic applied-index + snapshot blob
+    primitives) and mirbft_tpu/app/ (their single consumer, the
+    CommitStream's persistence); a third call site could persist app
+    state without the applied-index coupling and break exactly-once
+    apply across restart."""
+    import lint
+
+    outside = tmp_path / "mirbft_tpu" / "cluster" / "sneaky.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text(
+        "from ..runtime.storage import write_app_state\n"
+        "write_app_state('p', b'x')\n"
+    )
+    findings = lint.check_file(outside)
+    assert any("W18" in line for line in findings), findings
+
+    attr = tmp_path / "mirbft_tpu" / "runtime" / "sneaky2.py"
+    attr.parent.mkdir(parents=True)
+    attr.write_text(
+        "from . import storage\n"
+        "blob = storage.read_app_state('p')\n"
+        "x = blob\n"
+    )
+    assert any("W18" in line for line in lint.check_file(attr))
+
+    cleanup = tmp_path / "mirbft_tpu" / "chaos" / "sneaky3.py"
+    cleanup.parent.mkdir(parents=True)
+    cleanup.write_text(
+        "from ..runtime.storage import remove_app_state\n"
+        "remove_app_state('p')\n"
+    )
+    assert any("W18" in line for line in lint.check_file(cleanup))
+
+    # The sanctioned owners, checked against the real sources.
+    assert not any(
+        "W18" in line
+        for line in lint.check_file(
+            REPO / "mirbft_tpu" / "runtime" / "storage.py"
+        )
+    )
+    assert not any(
+        "W18" in line
+        for line in lint.check_file(REPO / "mirbft_tpu" / "app" / "stream.py")
+    )
+
+    # Tests and tools are out of scope entirely.
+    tests_ok = tmp_path / "tests" / "test_whatever.py"
+    tests_ok.parent.mkdir(parents=True)
+    tests_ok.write_text(
+        "from mirbft_tpu.runtime.storage import read_app_state\n"
+        "x = read_app_state('p')\n"
+    )
+    assert not any("W18" in line for line in lint.check_file(tests_ok))
+
+
 # ---------------------------------------------------------------------------
 # rule engine (tools/analysis/engine.py)
 # ---------------------------------------------------------------------------

@@ -1,4 +1,4 @@
-"""General defect classes W1..W17 (the original tools/lint.py checks as
+"""General defect classes W1..W18 (the original tools/lint.py checks as
 Rule objects, message-compatible, plus the seeded-randomness ban and the
 adversary-tooling, resource-introspection, device-timing, and
 snapshot-I/O confinements).
@@ -50,6 +50,14 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   authority) lives in exactly two files.  A third call site would fork
   the atomicity/cleanup discipline and let a crash mid-transfer leave
   state the restart path does not know how to interpret.
+- W18 app-state file I/O (``write_app_state`` / ``read_app_state`` /
+  ``remove_app_state``) outside ``runtime/storage.py`` and
+  ``mirbft_tpu/app/`` — the applied-index + state-machine snapshot is
+  written as one atomic blob (tmp + fsync + rename) so a crash between
+  "state applied" and "index recorded" cannot double-apply on restart.
+  Storage owns the primitive, the app layer is its only caller; a call
+  site anywhere else could persist app state without the applied-index
+  coupling and silently break exactly-once apply.
 """
 
 from __future__ import annotations
@@ -133,12 +141,16 @@ def in_exposition_scope(posix: str) -> bool:
     return "mirbft_tpu/" in posix and "mirbft_tpu/obsv/" not in posix
 
 
-# The only two files allowed to touch raw sockets: the transport owns
-# framing/reconnect/counters, and the live chaos driver's partition
-# proxies sit deliberately *under* the transport at the socket layer.
+# The only files allowed to touch raw sockets: the transport owns
+# framing/reconnect/counters, the live chaos driver's partition proxies
+# sit deliberately *under* the transport at the socket layer, and the
+# app service is the client-facing edge (clients are outside the
+# replica-to-replica transport by design — they speak the public KV
+# framing, not the node wire protocol).
 SOCKET_ALLOWED_FILES = (
     "mirbft_tpu/runtime/transport.py",
     "mirbft_tpu/chaos/live.py",
+    "mirbft_tpu/app/service.py",
 )
 
 
@@ -150,12 +162,14 @@ def in_socket_ban_scope(posix: str) -> bool:
 
 
 # The only files allowed to call os.fsync: the stores own the
-# group-commit coalescer, and the live chaos driver's durable app log
+# group-commit coalescer, and the app package's durable apply journal
 # models an application fsyncing its own state (deliberately outside the
-# group-commit path, like a real app would be).
+# group-commit path, like a real app would be).  chaos/live.py keeps its
+# allowance for historical shims around that journal.
 FSYNC_ALLOWED_FILES = (
     "mirbft_tpu/runtime/storage.py",
     "mirbft_tpu/chaos/live.py",
+    "mirbft_tpu/app/journal.py",
 )
 
 # The one module (and the one helper inside it) allowed to create
@@ -260,6 +274,31 @@ def in_snapshot_io_ban_scope(posix: str) -> bool:
     """True for mirbft_tpu files where W17 bans snapshot file I/O."""
     return "mirbft_tpu/" in posix and not any(
         posix.endswith(allowed) for allowed in SNAPSHOT_IO_ALLOWED_FILES
+    )
+
+
+# The only places allowed to persist app state: storage.py owns the
+# atomic write/read/remove primitives (applied index and state-machine
+# snapshot travel as ONE blob) and the app package is their single
+# consumer.  A third call site could persist app state without the
+# applied-index coupling and break exactly-once apply across restart.
+APP_STATE_IO_ALLOWED_FILE = "mirbft_tpu/runtime/storage.py"
+APP_STATE_IO_ALLOWED_TREE = "mirbft_tpu/app/"
+
+# References to these names anywhere else in mirbft_tpu/ trip W18.
+APP_STATE_IO_FUNCS = (
+    "write_app_state",
+    "read_app_state",
+    "remove_app_state",
+)
+
+
+def in_app_state_io_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W18 bans app-state file I/O."""
+    return (
+        "mirbft_tpu/" in posix
+        and not posix.endswith(APP_STATE_IO_ALLOWED_FILE)
+        and APP_STATE_IO_ALLOWED_TREE not in posix
     )
 
 
@@ -766,6 +805,26 @@ def _check_w17(ctx: FileContext):
                 yield Finding("W17", ctx.path, node.lineno, msg)
 
 
+def _check_w18(ctx: FileContext):
+    msg = (
+        "app-state file I/O outside runtime/storage.py and mirbft_tpu/app/ "
+        "(the applied index and the state-machine snapshot are persisted "
+        "as one atomic blob; storage owns the primitive and the app layer "
+        "is its only caller — anything else risks double-apply after a "
+        "crash)"
+    )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            if any(alias.name in APP_STATE_IO_FUNCS for alias in node.names):
+                yield Finding("W18", ctx.path, node.lineno, msg)
+        elif isinstance(node, ast.Name):
+            if node.id in APP_STATE_IO_FUNCS:
+                yield Finding("W18", ctx.path, node.lineno, msg)
+        elif isinstance(node, ast.Attribute):
+            if node.attr in APP_STATE_IO_FUNCS:
+                yield Finding("W18", ctx.path, node.lineno, msg)
+
+
 def _as_list(gen_fn):
     def check(ctx):
         return list(gen_fn(ctx))
@@ -953,6 +1012,21 @@ register(
         ),
         check=_as_list(_check_w17),
         scope=in_snapshot_io_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W18",
+        title="app-state file I/O outside storage.py/app/",
+        doc=(
+            "write_app_state/read_app_state/remove_app_state are confined "
+            "to runtime/storage.py (the atomic applied-index + snapshot "
+            "blob primitives) and mirbft_tpu/app/ (their single consumer); "
+            "a third call site could persist app state without the "
+            "applied-index coupling and break exactly-once apply."
+        ),
+        check=_as_list(_check_w18),
+        scope=in_app_state_io_ban_scope,
     )
 )
 register(
