@@ -28,13 +28,13 @@ certified value before adopting it.
 from __future__ import annotations
 
 import hashlib
-import queue
 import struct
 import threading
 import time
 from collections import OrderedDict
 
 from ..obsv import hooks
+from ..obsv.bqueue import BoundedQueue
 from ..runtime import storage
 from ..runtime.processor import Log
 
@@ -128,7 +128,7 @@ class CommitStream(Log):
         self.data_source = data_source  # callable(RequestAck) -> bytes|None
         self.chain_source = chain_source  # callable() -> journal chain
         self.queue_depth = queue_depth
-        self._queue: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._queue = BoundedQueue("app.apply", maxsize=queue_depth)
         self._cv = threading.Condition()
         # App-thread frontier: the exactly-once floor.
         self.applied_seq = 0

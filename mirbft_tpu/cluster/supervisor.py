@@ -119,6 +119,7 @@ class ClusterSupervisor:
         deferred_nodes=(),
         checkpoint_interval: int | None = None,
         app: str | None = None,
+        trace: bool = False,
     ):
         if profile not in WAN_PROFILES:
             raise ValueError(
@@ -172,6 +173,10 @@ class ClusterSupervisor:
         )
         self.checkpoint_interval = checkpoint_interval
         self.app = app  # "kv" installs the replicated KV service per node
+        # Per-node milestone tracing: each worker dumps <dir>/trace.json
+        # (clock_sync-stamped) on graceful shutdown, the input for
+        # obsv --critpath / the knee rung's saturation attribution.
+        self.trace = trace
         self._booted: set = set()  # ids with a known transport address
         # Guards the client transport handle: submit() runs on load
         # generator threads while teardown() runs on the driver thread,
@@ -211,6 +216,8 @@ class ClusterSupervisor:
             spec["checkpoint_interval"] = int(self.checkpoint_interval)
         if self.app is not None:
             spec["app"] = self.app
+        if self.trace:
+            spec["trace"] = True
         return spec
 
     def _spawn(self, handle: _NodeHandle) -> None:

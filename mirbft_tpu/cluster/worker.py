@@ -123,7 +123,13 @@ class Worker:
             autoflush_every=int(spec.get("flight_autoflush", 256)),
             registry=registry,
         )
-        hooks.enable(registry=registry, trace=False, recorder=self.recorder)
+        # Spec "trace": capture milestone instants/flows in-process and
+        # dump <dir>/trace.json on graceful shutdown (clock_sync-stamped
+        # in wire(), so obsv --critpath / --merge can align the nodes).
+        self._trace = bool(spec.get("trace", False))
+        _, self.tracer = hooks.enable(
+            registry=registry, trace=self._trace, recorder=self.recorder
+        )
         self.wal = FileWal(os.path.join(self.dir, "wal"))
         self.reqstore = FileRequestStore(os.path.join(self.dir, "reqs"))
         # The KV app (spec "app": "kv") layers the commit stream + state
@@ -285,6 +291,11 @@ class Worker:
         # stamp them into the recorder so --postmortem aligns this
         # node's dump with its peers' exactly like live trace merging.
         self.recorder.set_clock_offsets(self.transport.clock_offsets())
+        if self.tracer is not None:
+            self.tracer.set_clock_sync(
+                self.node_id, self.transport.clock_offsets()
+            )
+            self.tracer.name_thread(self.node_id, f"node {self.node_id}")
         self.recorder.record_note("worker.ready", args={"pid": os.getpid()})
         # Commit a baseline segment now: a SIGKILL that lands before the
         # first autoflush threshold must still find a dump to annotate.
@@ -479,6 +490,11 @@ class Worker:
             write_json_atomic(
                 os.path.join(self.dir, "metrics.json"), snapshot
             )
+            if self.tracer is not None:
+                try:
+                    self.tracer.write(os.path.join(self.dir, "trace.json"))
+                except OSError:
+                    pass  # trace dump is best-effort, like the recorder
         else:
             self.wal.crash()
             self.reqstore.crash()

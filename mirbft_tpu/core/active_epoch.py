@@ -382,7 +382,33 @@ class ActiveEpoch:
 
     # -- ticks ---------------------------------------------------------------
 
+    def _export_bucket_backlog(self) -> None:
+        """Per-bucket backlog gauges, sampled on tick: sequences past
+        UNINITIALIZED but not yet COMMITTED inside the active window.
+        A persistently lopsided backlog is the skewed-traffic signal —
+        one leader's bucket absorbing the hot clients while the others
+        idle (status.py surfaces the max/median ratio)."""
+        from ..obsv import hooks
+
+        if not hooks.enabled:
+            return
+        backlog = self.bucket_backlog()
+        m = hooks.metrics
+        for bucket, depth in enumerate(backlog):
+            m.gauge("mirbft_bucket_backlog", bucket=str(bucket)).set(depth)
+
+    def bucket_backlog(self) -> list:
+        """In-flight (allocated-but-uncommitted) sequence count per
+        bucket over the active window."""
+        backlog = [0] * len(self.buckets)
+        for seq_no in range(self.low_watermark(), self.high_watermark() + 1):
+            state = self.sequence(seq_no).state
+            if state not in (SeqState.UNINITIALIZED, SeqState.COMMITTED):
+                backlog[self.seq_bucket(seq_no)] += 1
+        return backlog
+
     def tick(self) -> Actions:
+        self._export_bucket_backlog()
         if self.last_committed_at_tick < self.commit_state.highest_commit:
             self.last_committed_at_tick = self.commit_state.highest_commit
             self.ticks_since_progress = 0

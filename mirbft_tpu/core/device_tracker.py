@@ -49,10 +49,12 @@ jax (lint rule W16); the purity auditor treats it as a boundary module
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
 from ..obsv import hooks
+from ..obsv.bqueue import QueueTelemetry
 
 # Tick classes and flags shared with _FastAcks (same values, same meaning).
 COMMITTED = 1
@@ -506,6 +508,11 @@ class DeviceClientPlane:
         self._snapshot: dict | None = None
         self._pending: list = []  # [(src, ci, w, rno, dig_words, msgs?)]
         self._pending_rows = 0
+        # Staged-frame backpressure telemetry: depth = queued ack rows,
+        # wait = first-staged-row age at flush, saturated = flushes
+        # forced by the coalescing threshold (vs sync-point flushes).
+        self.telemetry = QueueTelemetry("device.ack_stage")
+        self._stage_started = 0.0
         self._events: list = []  # flush boundary outputs awaiting drain
         # Cumulative plane counters (bench/report surface).
         self.acks_applied = 0
@@ -699,9 +706,14 @@ class DeviceClientPlane:
                 else msgs,
             )
         )
+        was_empty = not self._pending_rows
         self._pending_rows += int(in_win.sum()) if len(out_rows) else len(
             rnos
         )
+        if hooks.enabled:
+            if was_empty and self._pending_rows:
+                self._stage_started = time.perf_counter()
+            self.telemetry.depth(self._pending_rows)
         return out_rows
 
     def flush(self, drain) -> None:
@@ -714,6 +726,13 @@ class DeviceClientPlane:
             return
         import jax
 
+        if hooks.enabled:
+            if self._stage_started:
+                self.telemetry.wait(
+                    max(0.0, time.perf_counter() - self._stage_started)
+                )
+            self._stage_started = 0.0
+            self.telemetry.depth(0)
         self._flush_staged()
         pending, self._pending = self._pending, []
         n = self._pending_rows
@@ -930,6 +949,9 @@ class DeviceClientPlane:
             )
             tail = []
         if self._pending_rows >= self.flush_rows:
+            # Coalescing threshold hit: the staging buffer is "full" in
+            # the backpressure sense (vs a sync-point-forced flush).
+            self.telemetry.saturated()
             self.flush(drain=tracker)
         # out_rows index the SUBMITTED subset, not the original frame:
         # replay through kept_msgs so a filtered null-digest row can

@@ -58,6 +58,11 @@ class StepResult:
     p95_ms: float = 0.0
     p99_ms: float = 0.0
     latencies_ms: list = field(default_factory=list)
+    # Per-request commit records for critical-path attribution
+    # (obsv/critpath.py joins these to trace flow milestones by seq):
+    # dicts {client_id, req_no, seq, node, submit_ns, commit_ns}.
+    # Not part of the SLO artifact (slo.py enumerates its fields).
+    records: list = field(default_factory=list)
 
     def finalize(self) -> None:
         self.goodput_per_sec = (
@@ -169,7 +174,7 @@ class LoadGenerator:
         return result
 
     def _observe(self, pending: dict, result: StepResult) -> None:
-        for _node, client_id, req_no, _seq, ts_ns in self.cluster.poll_commits():
+        for node, client_id, req_no, seq, ts_ns in self.cluster.poll_commits():
             entry = pending.pop((client_id, req_no), None)
             if entry is None:
                 continue  # another node's commit already scored it
@@ -178,6 +183,16 @@ class LoadGenerator:
                 max(0.0, (end_ns - entry.submit_ns) / 1e6)
             )
             result.committed += 1
+            result.records.append(
+                {
+                    "client_id": client_id,
+                    "req_no": req_no,
+                    "seq": seq,
+                    "node": node,
+                    "submit_ns": entry.submit_ns,
+                    "commit_ns": end_ns,
+                }
+            )
 
     def _retry(self, pending: dict, result: StepResult, start: float) -> None:
         now_s = time.monotonic() - start

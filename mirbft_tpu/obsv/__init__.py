@@ -21,9 +21,11 @@ one artifact against another.
 from __future__ import annotations
 
 from . import hooks
+from .bqueue import BoundedQueue, QueueTelemetry
+from .critpath import FlowRecord, attribute, attribution_table, build_ledger
 from .diff import diff_files, diff_series, extract_series
 from .exporter import ObsvExporter
-from .merge import merge_files, merge_traces, split_node_traces
+from .merge import aligned_events, merge_files, merge_traces, split_node_traces
 from .metrics import (
     CARDINALITY,
     CATALOG,
@@ -39,20 +41,27 @@ from .timeline import PHASES, PhaseStats, TimelineProfiler
 from .trace import SpanSampler, Tracer
 
 __all__ = [
+    "BoundedQueue",
     "CARDINALITY",
     "CATALOG",
     "CATALOG_LABELS",
     "CardinalityError",
     "DEFAULT_BUCKETS",
     "DEFAULT_CARDINALITY",
+    "FlowRecord",
     "NullRegistry",
     "ObsvExporter",
     "PHASES",
     "PhaseStats",
+    "QueueTelemetry",
     "Registry",
     "SpanSampler",
     "TimelineProfiler",
     "Tracer",
+    "aligned_events",
+    "attribute",
+    "attribution_table",
+    "build_ledger",
     "diff_files",
     "diff_series",
     "extract_series",

@@ -22,6 +22,11 @@ Tool modes (mutually exclusive with the run):
   recorder dump under DIR into one clock-aligned causal timeline ending
   at the failure (obsv/recorder.py); ``--out`` also writes the merged
   Chrome trace for Perfetto.
+- ``--critpath DIR`` — build the per-request critical-path ledger from
+  a run directory (per-node ``trace*.json`` files, optional
+  ``records.json`` loadgen records) and print the per-percentile-band
+  saturation attribution: which phase dominated, on which node
+  (obsv/critpath.py).
 """
 
 from __future__ import annotations
@@ -70,6 +75,10 @@ def main(argv=None) -> int:
     parser.add_argument("--postmortem", metavar="DIR",
                         help="postmortem mode: merge flight recorder "
                         "dumps under DIR into one causal timeline")
+    parser.add_argument("--critpath", metavar="DIR",
+                        help="critical-path mode: per-request phase "
+                        "attribution from a run directory of per-node "
+                        "trace*.json files (+ optional records.json)")
     parser.add_argument("--out", metavar="PATH",
                         help="write the merged postmortem trace here "
                         "(--postmortem only)")
@@ -80,11 +89,35 @@ def main(argv=None) -> int:
 
     if args.postmortem:
         return _postmortem_main(args)
+    if args.critpath:
+        return _critpath_main(args)
     if args.diff:
         return _diff_main(args)
     if args.merge:
         return _merge_main(args)
     return _run_main(args)
+
+
+def _critpath_main(args) -> int:
+    from .critpath import attribute, attribution_table, ledger_from_dir
+
+    try:
+        ledger, n_traces = ledger_from_dir(args.critpath)
+    except (FileNotFoundError, NotADirectoryError) as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if not n_traces:
+        print(f"no trace*.json files under {args.critpath}", file=sys.stderr)
+        return 2
+    attribution = attribute(ledger)
+    print(
+        f"critpath: {len(ledger)} committed flow(s) from {n_traces} "
+        f"node trace(s) under {args.critpath}"
+    )
+    print()
+    print(attribution_table(attribution))
+    print(json.dumps({"bands": attribution}))
+    return 0
 
 
 def _diff_main(args) -> int:

@@ -21,6 +21,11 @@ Supported artifact shapes (auto-detected):
   p99_ms,committed_reqs,…}`` — so a latency-SLO regression between two
   load runs gates exactly like a timeline regression (``duplicates``
   and ``timed_out`` are reported as informational).
+- **capacity JSON** (``schema: mirbft-capacity/…``, or nested under a
+  bench JSON's ``capacity`` key): per config,
+  ``knee.<config>.knee_rate_per_sec`` (a knee moving *down* gates) and
+  ``knee.<config>.p95_at_knee_ms``, plus the headline
+  ``knee_rate_per_sec``.
 
 Direction is inferred per series name: throughput-like series
 (``per_sec``, ``rate``, ``count``, ``events``) regress when they *drop*;
@@ -107,10 +112,44 @@ def _loadgen_series(doc, prefix=""):
     return series
 
 
+def _capacity_series(doc, prefix=""):
+    """Series from a ``mirbft-capacity`` artifact (loadgen/knee.py).
+
+    ``knee_rate_per_sec`` carries the ``per_sec`` token, so a knee that
+    moves down between artifacts gates as a regression exactly like a
+    p95 rise; ``p95_at_knee_ms`` (the p95 of the highest passing step)
+    gates lower-is-better.  A config whose knee was not located within
+    budget contributes no knee series (absent, not zero — a located
+    knee appearing later must not diff against a fake 0).
+    """
+    series = {}
+    top = doc.get("knee_rate_per_sec")
+    if isinstance(top, (int, float)) and not isinstance(top, bool):
+        series[f"{prefix}knee_rate_per_sec"] = float(top)
+    for config in doc.get("configs") or []:
+        name = config.get("config", "config")
+        knee = config.get("knee_rate_per_sec")
+        if isinstance(knee, (int, float)) and not isinstance(knee, bool):
+            series[f"{prefix}knee.{name}.knee_rate_per_sec"] = float(knee)
+        passing = [
+            s
+            for s in config.get("steps") or []
+            if s.get("ok") and isinstance(s.get("rate_per_sec"), (int, float))
+        ]
+        if passing:
+            at_knee = max(passing, key=lambda s: s["rate_per_sec"])
+            p95 = at_knee.get("p95_ms")
+            if isinstance(p95, (int, float)) and not isinstance(p95, bool):
+                series[f"{prefix}knee.{name}.p95_at_knee_ms"] = float(p95)
+    return series
+
+
 def extract_series(artifact):
     """Flatten one parsed artifact into ``{series_name: float}``."""
     if str(artifact.get("schema", "")).startswith("mirbft-loadgen-slo"):
         return _loadgen_series(artifact)
+    if str(artifact.get("schema", "")).startswith("mirbft-capacity"):
+        return _capacity_series(artifact)
     if "traceEvents" in artifact:
         profiler = TimelineProfiler.from_chrome_trace(artifact)
         series = {}
@@ -138,6 +177,9 @@ def extract_series(artifact):
     app_doc = artifact.get("loadgen_app")
     if isinstance(app_doc, dict):
         series.update(_loadgen_series(app_doc, prefix="loadgen_app."))
+    capacity_doc = artifact.get("capacity")
+    if isinstance(capacity_doc, dict):
+        series.update(_capacity_series(capacity_doc, prefix="capacity."))
     device = artifact.get("device")
     if isinstance(device, dict):
         for fn, n in sorted((device.get("retraces") or {}).items()):
@@ -295,6 +337,14 @@ def load_artifact(path):
     ).startswith("mirbft-bench-stream"):
         # A one-line journal (header only, run died before any stage).
         return recover_stream(path)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict) and (
+        "cmd" in doc and "rc" in doc
+    ):
+        # A committed BENCH_r*.json wrapper ({n, cmd, rc, tail, parsed}):
+        # the bench payload lives under "parsed" — diff that, so the
+        # PR-over-PR gate compares the actual series instead of the
+        # wrapper's bookkeeping fields.
+        return doc["parsed"]
     return doc
 
 

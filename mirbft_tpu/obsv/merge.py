@@ -91,12 +91,18 @@ def _clock_sync_of(trace):
     return {}
 
 
-def merge_traces(traces):
-    """Merge per-node trace objects into one Chrome trace object.
+def aligned_events(traces):
+    """Align per-node traces onto the reference node's absolute clock.
 
-    ``traces`` is an iterable of parsed Chrome trace dicts, each ideally
-    carrying ``clock_sync`` metadata.  Traces without it get node ids
-    assigned by position and no clock shift (documented degradation).
+    Returns ``(shifted, plans)``: ``shifted`` is a list of
+    ``(abs_us, node, event)`` where ``abs_us`` is the event's absolute
+    time on the reference node's CLOCK_MONOTONIC in microseconds (NOT
+    rebased to zero — critpath joins these against loadgen's raw
+    ``monotonic_ns`` stamps, which live on the same clock when loadgen
+    runs on the reference host); ``plans`` is the sorted
+    ``(node, clock_sync, trace)`` list.  The reference clock is the
+    lowest node id's; its ``offsets_ns`` map shifts every peer lane.
+    Metadata (``ph: "M"``) events are excluded.
     """
     traces = list(traces)
     plans = []
@@ -106,25 +112,36 @@ def merge_traces(traces):
         plans.append((node, sync, trace))
     plans.sort(key=lambda p: p[0])
     if not plans:
-        return {"traceEvents": []}
+        return [], []
 
-    # The lowest node id is the reference clock; its offsets_ns map
-    # shifts every peer lane onto its timeline.
     ref_node, ref_sync, _ = plans[0]
     ref_offsets = ref_sync.get("offsets_ns") or {}
 
-    merged = []
     shifted = []  # (abs_us, node, event)
     for node, sync, trace in plans:
         t0_ns = sync.get("t0_ns", 0)
         offset_ns = 0 if node == ref_node else int(ref_offsets.get(str(node), 0))
         for event in trace.get("traceEvents", ()):
             if event.get("ph") == "M":
-                continue  # re-synthesized below on merged pids
+                continue
             ev = dict(event)
             abs_us = (t0_ns + offset_ns) / 1000.0 + float(ev.get("ts", 0.0))
             shifted.append((abs_us, node, ev))
+    return shifted, plans
 
+
+def merge_traces(traces):
+    """Merge per-node trace objects into one Chrome trace object.
+
+    ``traces`` is an iterable of parsed Chrome trace dicts, each ideally
+    carrying ``clock_sync`` metadata.  Traces without it get node ids
+    assigned by position and no clock shift (documented degradation).
+    """
+    shifted, plans = aligned_events(traces)
+    if not plans:
+        return {"traceEvents": []}
+
+    merged = []
     if shifted:
         base_us = min(abs_us for abs_us, _, _ in shifted)
     else:

@@ -45,6 +45,7 @@ CATALOG = {
     "mirbft_app_writes_total": "KV service writes, by mode (put/delete/cas) and outcome (ok/not_found/cas_conflict/malformed/timeout/rejected).",
     "mirbft_bench_stage_compile_seconds": "bench.py per-stage warmup/compile seconds (JAX/Mosaic compiles triggered before the timed window).",
     "mirbft_bench_stage_seconds": "bench.py per-stage wall-clock seconds.",
+    "mirbft_bucket_backlog": "Per-bucket consensus backlog: sequences allocated but not yet committed in the active epoch, sampled on tick (the skew/imbalance signal).",
     "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/oversized_snapshot_chunk/malformed).",
     "mirbft_checkpoint_lag_seqnos": "Sequence distance from this node's checkpoint window to the newest 2f+1-certified above-window checkpoint (0 when caught up; the state-transfer trigger).",
     "mirbft_censored_commit_epochs": "Epoch rotations a censored-but-retried request needed before committing, per scenario.",
@@ -66,8 +67,12 @@ CATALOG = {
     "mirbft_engine_sim_ms": "Final simulated clock of a testengine Recorder run.",
     "mirbft_epoch_change_seconds": "Wall time from constructing an epoch change to activating the new epoch, per node observation.",
     "mirbft_epoch_events_total": "Epoch-change milestones (changing/active), by event and epoch.",
+    "mirbft_flow_abandoned_total": "Open-flow table entries evicted before a terminal milestone (requests censored/dropped under chaos; bounded-eviction pressure).",
     "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
     "mirbft_proc_stage_queue_depth": "Pipelined processor: batches queued at each stage hand-off.",
+    "mirbft_queue_depth": "Items queued in a bounded hot-path queue, by queue name (emitted only through the obsv.bqueue shim; lint rule W19).",
+    "mirbft_queue_saturated_total": "Put attempts that found a bounded hot-path queue at capacity (blocked, dropped-oldest, or forced a flush), by queue name.",
+    "mirbft_queue_wait_seconds": "Seconds an item spent inside a bounded hot-path queue (enqueue to dequeue), by queue name.",
     "mirbft_recorder_overwritten_total": "Flight-recorder ring slots overwritten before ever reaching a dump.",
     "mirbft_recorder_records_total": "Flight-recorder entries recorded, by kind (event/milestone/resource/note).",
     "mirbft_reqstore_appends_total": "Request-store record appends.",
@@ -109,6 +114,7 @@ CATALOG_LABELS = {
     "mirbft_app_writes_total": ("mode", "outcome"),
     "mirbft_bench_stage_compile_seconds": ("stage",),
     "mirbft_bench_stage_seconds": ("stage",),
+    "mirbft_bucket_backlog": ("bucket",),
     "mirbft_byzantine_rejections_total": ("kind",),
     "mirbft_checkpoint_lag_seqnos": (),
     "mirbft_censored_commit_epochs": ("scenario",),
@@ -130,8 +136,12 @@ CATALOG_LABELS = {
     "mirbft_engine_sim_ms": ("stage",),
     "mirbft_epoch_change_seconds": (),
     "mirbft_epoch_events_total": ("event", "epoch"),
+    "mirbft_flow_abandoned_total": (),
     "mirbft_proc_phase_seconds": ("phase",),
     "mirbft_proc_stage_queue_depth": ("stage",),
+    "mirbft_queue_depth": ("queue",),
+    "mirbft_queue_saturated_total": ("queue",),
+    "mirbft_queue_wait_seconds": ("queue",),
     "mirbft_recorder_overwritten_total": (),
     "mirbft_recorder_records_total": ("kind",),
     "mirbft_reqstore_appends_total": (),
@@ -181,6 +191,16 @@ CARDINALITY = {
     # 2 read modes x 3 outcomes; 3 write ops x 6 outcomes.
     "mirbft_app_reads_total": 8,
     "mirbft_app_writes_total": 24,
+    # One series per named bounded queue: 4 processor stages + app apply
+    # + device staging + one per transport peer (mp clusters run <= a few
+    # dozen peers per process).  Over-budget registration degrades to
+    # "series dropped" inside the bqueue shim, never an exception on the
+    # hot path.
+    "mirbft_queue_depth": 64,
+    "mirbft_queue_saturated_total": 64,
+    "mirbft_queue_wait_seconds": 64,
+    # One series per active-epoch bucket (bounded by the leader set).
+    "mirbft_bucket_backlog": 256,
 }
 
 

@@ -39,6 +39,12 @@ FLOW_TERMINAL = frozenset({"seq.committed"})
 #: Metadata record name carrying the tracer's monotonic anchor.
 CLOCK_SYNC = "clock_sync"
 
+#: Open-flow table bound.  Flows whose request dies before a terminal
+#: milestone (censored under chaos, dropped by backpressure) would
+#: otherwise pin their table entry forever; past this many open flows
+#: the oldest is evicted and counted as abandoned.
+MAX_OPEN_FLOWS = 4096
+
 
 class SpanSampler:
     """Deterministic 1-in-k span sampling.
@@ -119,15 +125,24 @@ class Tracer:
     the testengine is single-threaded anyway.
     """
 
-    def __init__(self, sampler: SpanSampler | None = None):
+    def __init__(
+        self,
+        sampler: SpanSampler | None = None,
+        max_open_flows: int = MAX_OPEN_FLOWS,
+    ):
         self._t0_ns = time.perf_counter_ns()
         self.events = []
         self._thread_names = {}
         self._sampler = sampler
         # Open flows keyed by (tid, seq_no) -> flow id string.  The
         # terminal milestone site (engine apply / runtime commit) does
-        # not know epoch/bucket, so it resolves the id here.
+        # not know epoch/bucket, so it resolves the id here.  Bounded:
+        # flows that never reach a terminal milestone are evicted
+        # oldest-first past max_open_flows (dict insertion order is the
+        # open order) and counted in ``abandoned_flows``.
         self._flows = {}
+        self._max_open_flows = max(1, max_open_flows)
+        self.abandoned_flows = 0
         self._clock_sync = None
 
     @property
@@ -222,6 +237,8 @@ class Tracer:
         if flow_id is None:
             if epoch is None or bucket is None:
                 return
+            if len(self._flows) >= self._max_open_flows:
+                self._evict_oldest_flow()
             flow_id = f"{epoch}.{seq_no}.{bucket}"
             self._flows[key] = flow_id
             ph = "s"
@@ -243,6 +260,20 @@ class Tracer:
             # Bind to the enclosing slice's end rather than the next one.
             event["bp"] = "e"
         self.events.append(event)
+
+    def _evict_oldest_flow(self):
+        """Drop the oldest open flow (no terminal milestone ever came:
+        the request was censored or dropped).  Counted both on the
+        tracer and — when a registry is live — as
+        ``mirbft_flow_abandoned_total`` so chaos runs can see censoring
+        pressure without parsing the trace."""
+        self._flows.pop(next(iter(self._flows)))
+        self.abandoned_flows += 1
+        from . import hooks  # local: trace is imported before hooks wires up
+
+        registry = hooks.metrics
+        if hooks.enabled and registry is not None:
+            registry.counter("mirbft_flow_abandoned_total").inc()
 
     def flow_step(self, name, tid, flow_id):
         """Freestanding ph:"t" flow record with an explicit id.

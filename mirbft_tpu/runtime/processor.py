@@ -425,10 +425,16 @@ class PipelinedProcessor(SerialProcessor):
         self._closed = False  # guarded-by: _mutex
         self._inflight = 0  # guarded-by: _mutex
         self._inflight_cv = threading.Condition(self._mutex)
-        self._persist_q = queue_mod.Queue(maxsize=self._QUEUE_DEPTH)
-        self._barrier_q = queue_mod.Queue(maxsize=self._QUEUE_DEPTH)
-        self._transmit_q = queue_mod.Queue(maxsize=self._QUEUE_DEPTH)
-        self._commit_q = queue_mod.Queue(maxsize=self._QUEUE_DEPTH)
+        # BoundedQueue (obsv/bqueue.py) gives every stage hand-off the
+        # uniform mirbft_queue_{depth,wait_seconds,saturated_total}
+        # series; the names are shared across nodes in one process so
+        # the label space stays budgeted.
+        from ..obsv.bqueue import BoundedQueue
+
+        self._persist_q = BoundedQueue("proc.persist", self._QUEUE_DEPTH)
+        self._barrier_q = BoundedQueue("proc.barrier", self._QUEUE_DEPTH)
+        self._transmit_q = BoundedQueue("proc.transmit", self._QUEUE_DEPTH)
+        self._commit_q = BoundedQueue("proc.commit", self._QUEUE_DEPTH)
         self._hash_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1,
             thread_name_prefix=f"proc-pipe-hash-{node.config.id}",

@@ -55,6 +55,7 @@ import time
 
 from .. import pb, wire
 from ..obsv import hooks
+from ..obsv.bqueue import QueueTelemetry
 from ..resilience import Backoff
 from .processor import Link
 
@@ -190,6 +191,14 @@ class _PeerChannel:
         self.backoff = Backoff(
             base=transport.backoff_base, cap=transport.backoff_cap
         )
+        # Backpressure telemetry (obsv/bqueue.py): the deque cannot be
+        # swapped for a BoundedQueue (latency pairs + drop-oldest +
+        # coalesced drain under one cv), so the channel drives the
+        # QueueTelemetry handle at its own put/drain points.  Wait is
+        # head-of-line age: the stamp of the oldest queued frame,
+        # observed when a drain finally picks the head up.
+        self.telemetry = QueueTelemetry(f"transport.peer{peer_id}")
+        self._head_enqueued_at = 0.0  # guarded-by: cv
         # Drop/retry accounting (read via TcpTransport.counters()).
         self.enqueued = 0  # guarded-by: cv
         self.sent = 0  # guarded-by: cv
@@ -215,6 +224,7 @@ class _PeerChannel:
                 self.queue.popleft()
                 self.dropped_overflow += 1
                 _frame_outcome("dropped_overflow")
+                self.telemetry.saturated()
             lat = self.latency
             if lat is None:
                 self.queue.append(frame)
@@ -222,6 +232,10 @@ class _PeerChannel:
                 self.queue.append((lat.due(time.monotonic()), frame))
             self.enqueued += 1
             _frame_outcome("enqueued")
+            if hooks.enabled:
+                if len(self.queue) == 1:
+                    self._head_enqueued_at = time.perf_counter()
+                self.telemetry.depth(len(self.queue))
             self.cv.notify()
 
     def close(self, drain_timeout: float) -> None:
@@ -275,6 +289,16 @@ class _PeerChannel:
                         budget -= len(frame)
                     if not frames:
                         continue  # head not due yet (raced with enqueue)
+                if hooks.enabled and frames:
+                    now = time.perf_counter()
+                    if self._head_enqueued_at:
+                        self.telemetry.wait(
+                            max(0.0, now - self._head_enqueued_at)
+                        )
+                    # Frames left past the coalesce budget become the
+                    # new head; their age restarts at this drain.
+                    self._head_enqueued_at = now if self.queue else 0.0
+                    self.telemetry.depth(len(self.queue))
             entry = self._ensure_connected()
             if entry is None:
                 # Shut down while connecting/backing off: the burst (and

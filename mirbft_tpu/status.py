@@ -67,6 +67,11 @@ class StateMachineStatus:
     client_windows: list = field(default_factory=list)
     buckets: list = field(default_factory=list)
     checkpoints: list = field(default_factory=list)
+    # Skew signal: in-flight (allocated-but-uncommitted) sequences per
+    # bucket, and max/median of that vector — 1.0 means balanced load,
+    # large means one leader's bucket is absorbing the hot clients.
+    bucket_backlog: list = field(default_factory=list)
+    bucket_imbalance: float = 0.0
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, default=str)
@@ -84,6 +89,18 @@ _SEQ_CHARS = {
     SeqState.PREPARED: "P",
     SeqState.COMMITTED: "C",
 }
+
+
+def _imbalance_ratio(backlog: list) -> float:
+    """max/median of the per-bucket backlog vector (median floored at 1
+    so an idle cluster reads as ratio == max, not a division blowup).
+    1.0 when perfectly balanced or empty."""
+    if not backlog:
+        return 0.0
+    ordered = sorted(backlog)
+    n = len(ordered)
+    mid = ordered[n // 2] if n % 2 else (ordered[n // 2 - 1] + ordered[n // 2]) / 2.0
+    return max(ordered) / max(float(mid), 1.0)
 
 
 def _client_status(client) -> ClientStatus:
@@ -170,6 +187,14 @@ def state_machine_status(machine) -> StateMachineStatus:
             )
             for b in sorted(per_bucket)
         ]
+        backlog = [
+            sum(1 for c in per_bucket[b] if c not in (".", "C"))
+            for b in sorted(per_bucket)
+        ]
+        imbalance = _imbalance_ratio(backlog)
+    else:
+        backlog = []
+        imbalance = 0.0
 
     checkpoints = [
         CheckpointStatus(
@@ -200,6 +225,8 @@ def state_machine_status(machine) -> StateMachineStatus:
         client_windows=clients,
         buckets=buckets,
         checkpoints=checkpoints,
+        bucket_backlog=backlog,
+        bucket_imbalance=imbalance,
     )
 
 
@@ -510,6 +537,12 @@ def pretty(status: StateMachineStatus) -> str:
             marker = "*" if bucket.leader else " "
             lines.append(
                 f"  {marker}bucket {bucket.id}: {''.join(bucket.sequences)}"
+            )
+        if status.bucket_backlog:
+            lines.append(
+                "  backlog: "
+                + " ".join(str(n) for n in status.bucket_backlog)
+                + f"  (imbalance max/median {status.bucket_imbalance:.2f})"
             )
         lines.append("")
     if status.checkpoints:

@@ -1,0 +1,460 @@
+"""Saturation attribution: critpath ledger, knee finder, capacity gate.
+
+Covers the PR-18 observability contract end to end on synthetic
+fixtures with *known* answers:
+
+- the per-request critical-path ledger joins multi-node milestone
+  traces (including skewed per-node clocks) into exact phase
+  residencies and per-band dominant-phase attributions;
+- loadgen records resolve the two join phases (ingress/apply);
+- ``find_knee`` locates a knee on a synthetic latency curve, reports
+  the honest ``located=False`` when the SLO never breaks, and the
+  goodput criterion fails a collapsed probe whose tiny surviving
+  sample has a lucky p95;
+- an injected knee regression in a ``mirbft-capacity/1`` artifact makes
+  ``obsv --diff`` exit nonzero;
+- the ``--critpath DIR`` CLI renders the attribution for a run dir.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from mirbft_tpu.loadgen.knee import (
+    SCHEMA,
+    artifact,
+    config_doc,
+    find_knee,
+)
+from mirbft_tpu.obsv.critpath import (
+    attribute,
+    attribution_table,
+    build_ledger,
+    ledger_from_dir,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Every synthetic node's clock is skewed differently; the offsets below
+# make (t0_ns + offset_ns) identical across nodes, so an event's local
+# ``ts`` (µs since its own t0) doubles as its absolute time after
+# alignment — fixtures can state timelines in one shared µs domain.
+_T0 = {0: 1_000_000_000, 1: 500_000_000, 2: 2_000_000_000}
+_REF_OFFSETS = {"1": 500_000_000, "2": -1_000_000_000}
+_BASE_US = 1_000_000.0  # (t0 + offset) / 1000 for every node
+
+
+def _node_trace(node, instants):
+    """One node's Chrome trace: clock_sync metadata + milestone
+    instants ``(ts_us, name, args)`` (ts relative to the node's t0)."""
+    events = [
+        {
+            "name": "clock_sync",
+            "ph": "M",
+            "pid": 0,
+            "tid": 0,
+            "args": {
+                "node": node,
+                "t0_ns": _T0[node],
+                "offsets_ns": _REF_OFFSETS if node == 0 else {},
+            },
+        }
+    ]
+    for ts, name, args in instants:
+        events.append(
+            {
+                "name": name,
+                "cat": "consensus",
+                "ph": "i",
+                "pid": 0,
+                "tid": node,
+                "ts": float(ts),
+                "args": args,
+            }
+        )
+    return {"traceEvents": events}
+
+
+def _milestones(seq, *, alloc, pp, cq, committed, epoch=1, bucket=0):
+    """Per-node instant lists for one sequence.
+
+    ``pp``/``committed`` map node -> ts_us; ``alloc``/``cq`` are
+    ``(ts_us, node)``.  Returns {node: [(ts, name, args), ...]}.
+    """
+    def args(node, with_meta=False):
+        a = {"node": node, "seq": seq, "sim_ms": 0}
+        if with_meta:
+            a.update(epoch=epoch, bucket=bucket)
+        return a
+
+    out = {0: [], 1: [], 2: []}
+    ts, node = alloc
+    out[node].append((ts, "seq.allocated", args(node, with_meta=True)))
+    for node, ts in pp.items():
+        out[node].append((ts, "seq.preprepared", args(node, with_meta=True)))
+    ts, node = cq
+    out[node].append((ts, "seq.commit_quorum", args(node)))
+    for node, ts in committed.items():
+        out[node].append((ts, "seq.committed", args(node)))
+    return out
+
+
+def _merge_instants(*per_seq):
+    traces = []
+    for node in (0, 1, 2):
+        instants = []
+        for seq_map in per_seq:
+            instants.extend(seq_map[node])
+        traces.append(_node_trace(node, instants))
+    return traces
+
+
+def _transmit_bound_seq(seq, t):
+    """hash 500, transmit 3000 (node 2 closes), quorum 500, commit 200."""
+    return _milestones(
+        seq,
+        alloc=(t + 1000, 0),
+        pp={0: t + 1500, 1: t + 2500, 2: t + 4500},
+        cq=(t + 5000, 0),
+        committed={0: t + 5200, 1: t + 5300, 2: t + 6000},
+    )
+
+
+def _quorum_bound_seq(seq, t):
+    """hash 100, transmit 200, quorum 900 (node 1 closes cq), commit 50."""
+    return _milestones(
+        seq,
+        alloc=(t + 100, 0),
+        pp={0: t + 200, 1: t + 300, 2: t + 400},
+        cq=(t + 1300, 1),
+        committed={0: t + 1400, 1: t + 1350, 2: t + 1500},
+    )
+
+
+def test_ledger_exact_phases_across_skewed_clocks():
+    """Three nodes with wildly different t0 anchors produce the exact
+    phase residencies once the reference offsets are applied."""
+    traces = _merge_instants(_transmit_bound_seq(5, 0))
+    ledger = build_ledger(traces)
+    assert len(ledger) == 1
+    row = ledger[0]
+    assert row.seq == 5
+    assert row.epoch == 1 and row.bucket == 0
+    assert row.phases == {
+        "hash": 500.0,
+        "transmit": 3000.0,
+        "quorum": 500.0,
+        "commit": 200.0,
+    }
+    # The straggler (node 2) closes transmit; node 0 closes the rest.
+    assert row.phase_nodes["transmit"] == 2
+    assert row.phase_nodes["hash"] == 0
+    assert row.phase_nodes["commit"] == 0
+    # total = first committed - first allocated.
+    assert row.total_us == 4200.0
+
+
+def test_ledger_joins_loadgen_records_for_ingress_and_apply():
+    traces = _merge_instants(_transmit_bound_seq(5, 0))
+    # Submit 400 µs after the base instant; commit observed (by loadgen,
+    # via node 1's commit record) 500 µs after node 1 applied.
+    records = [
+        {
+            "client_id": 7,
+            "req_no": 3,
+            "seq": 5,
+            "node": 1,
+            "submit_ns": int((_BASE_US + 400) * 1000),
+            "commit_ns": int((_BASE_US + 5800) * 1000),
+        }
+    ]
+    ledger = build_ledger(traces, records)
+    assert len(ledger) == 1
+    row = ledger[0]
+    assert row.client_id == 7 and row.req_no == 3
+    assert row.phases["ingress"] == 600.0  # alloc@1000 - submit@400
+    assert row.phases["apply"] == 500.0  # obs@5800 - node1 committed@5300
+    assert row.phase_nodes["apply"] == 1
+    assert row.total_us == 5400.0  # commit - submit
+    # Records without trace evidence are skipped, not fabricated.
+    assert build_ledger(traces, [dict(records[0], seq=999)]) == []
+
+
+def test_attribution_bands_pick_dominant_phase_and_node():
+    """Two fast quorum-bound requests and two slow transmit-bound ones:
+    the lower band attributes to quorum, the upper to transmit, each
+    with the node that closed the dominant edge."""
+    traces = _merge_instants(
+        _quorum_bound_seq(10, 0),
+        _quorum_bound_seq(11, 10_000),
+        _transmit_bound_seq(20, 20_000),
+        _transmit_bound_seq(21, 30_000),
+    )
+    ledger = build_ledger(traces)
+    assert [r.seq for r in ledger] == [10, 11, 20, 21]  # sorted by total
+    bands = attribute(ledger, bands=((0.0, 0.5), (0.5, 1.0)))
+    assert [b["band"] for b in bands] == ["p0-p50", "p50-p100"]
+    fast, slow = bands
+    assert fast["count"] == 2 and slow["count"] == 2
+    assert fast["dominant_phase"] == "quorum"
+    assert fast["dominant_node"] == 1
+    assert fast["phase_us"]["quorum"] == 900.0
+    assert slow["dominant_phase"] == "transmit"
+    assert slow["dominant_node"] == 2
+    assert slow["phase_us"]["transmit"] == 3000.0
+    # The ASCII rendering names every phase column and the dominants.
+    table = attribution_table(bands)
+    assert "transmit" in table and "quorum" in table
+    assert "p50-p100" in table
+
+
+def test_ledger_from_dir_reads_cluster_layout(tmp_path):
+    """trace files one level down in node*/ (the supervisor root) and a
+    records.json are both picked up."""
+    traces = _merge_instants(_transmit_bound_seq(5, 0))
+    for i, trace in enumerate(traces):
+        node_dir = tmp_path / f"node{i}"
+        node_dir.mkdir()
+        (node_dir / "trace.json").write_text(json.dumps(trace))
+    (tmp_path / "records.json").write_text(
+        json.dumps(
+            [
+                {
+                    "client_id": 7,
+                    "req_no": 3,
+                    "seq": 5,
+                    "node": 1,
+                    "submit_ns": int((_BASE_US + 400) * 1000),
+                    "commit_ns": int((_BASE_US + 5800) * 1000),
+                }
+            ]
+        )
+    )
+    ledger, n_traces = ledger_from_dir(str(tmp_path))
+    assert n_traces == 3
+    assert len(ledger) == 1 and ledger[0].phases["ingress"] == 600.0
+
+
+def test_critpath_cli_renders_attribution(tmp_path):
+    traces = _merge_instants(
+        _quorum_bound_seq(10, 0), _transmit_bound_seq(20, 20_000)
+    )
+    for i, trace in enumerate(traces):
+        (tmp_path / f"trace{i}.json").write_text(json.dumps(trace))
+    proc = subprocess.run(
+        [sys.executable, "-m", "mirbft_tpu.obsv", "--critpath", str(tmp_path)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 committed flow(s)" in proc.stdout
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["bands"]
+    assert verdict["bands"][0]["dominant_phase"] in (
+        "ingress",
+        "hash",
+        "transmit",
+        "quorum",
+        "commit",
+        "apply",
+    )
+    # Empty/missing dirs are a distinct, nonzero exit.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc = subprocess.run(
+        [sys.executable, "-m", "mirbft_tpu.obsv", "--critpath", str(empty)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# Knee finder
+# ---------------------------------------------------------------------------
+
+
+class _Step:
+    def __init__(self, rate, p95_ms, committed=None, duration_s=1.0):
+        self.committed = int(rate if committed is None else committed)
+        self.p95_ms = p95_ms
+        self.p50_ms = p95_ms / 2
+        self.p99_ms = p95_ms * 1.2
+        self.goodput_per_sec = self.committed / duration_s
+
+
+def _synthetic_curve(capacity=450.0, base_ms=40.0):
+    """Latency gently rising below capacity, a cliff past it."""
+
+    def measure(rate):
+        if rate <= capacity:
+            return _Step(rate, base_ms + rate / 50.0)
+        return _Step(rate, base_ms * 50.0)
+
+    return measure
+
+
+def test_find_knee_brackets_synthetic_capacity():
+    result = find_knee(
+        _synthetic_curve(capacity=450.0),
+        50.0,
+        slo_p95_ms=100.0,
+        max_steps=12,
+        resolution=0.05,
+    )
+    assert result.located
+    # The knee is the highest *probed* passing rate: within resolution
+    # of the true 450/s capacity and never above it.
+    assert 400.0 <= result.knee_rate_per_sec <= 450.0
+    assert result.knee_rate_per_sec == result.max_measured_ok
+    # The ramp is geometric until the first failure, then bisection.
+    rates = [s["rate_per_sec"] for s in result.steps]
+    assert rates[:4] == [50.0, 100.0, 200.0, 400.0]
+    assert all(s["ok"] for s in result.steps[:4])
+    assert not result.steps[4]["ok"]  # 800 broke the SLO
+
+
+def test_find_knee_no_knee_within_budget_is_honest():
+    result = find_knee(
+        _synthetic_curve(capacity=10_000.0),
+        50.0,
+        slo_p95_ms=1_000.0,
+        max_rate=200.0,  # budget cleared before the SLO ever breaks
+        max_steps=12,
+    )
+    assert not result.located
+    assert result.knee_rate_per_sec is None
+    assert all(s["ok"] for s in result.steps)
+    assert result.max_measured_ok == 200.0
+
+
+def test_find_knee_all_fail_is_not_a_located_zero_knee():
+    """A cluster that never meets the SLO at any probed rate (wedged,
+    starved, or broken) must report located=False, not a located knee
+    of 0.0 — a zero would poison the artifact's min-across-configs
+    headline with a number that is not a capacity."""
+    result = find_knee(
+        lambda rate: _Step(rate, 50_000.0),  # SLO never holds
+        16.0,
+        slo_p95_ms=8_000.0,
+        max_steps=7,
+    )
+    assert not result.located
+    assert result.knee_rate_per_sec is None
+    assert result.max_measured_ok == 0.0
+    assert not any(s["ok"] for s in result.steps)
+    # And the artifact headline ignores the unlocated config entirely.
+    doc = artifact([config_doc("wedged", result)])
+    assert doc["knee_rate_per_sec"] is None
+
+
+def test_find_knee_goodput_criterion_fails_collapsed_probe():
+    """Past hard saturation almost nothing commits; the few survivors
+    can show a lucky p95 under the SLO.  The goodput floor must fail
+    the probe anyway."""
+
+    def measure(rate):
+        if rate <= 100.0:
+            return _Step(rate, 50.0)
+        return _Step(rate, 60.0, committed=1)  # collapse, lucky p95
+
+    loose = find_knee(measure, 50.0, slo_p95_ms=100.0, max_steps=4)
+    assert not loose.located  # p95 alone never breaks: no knee found
+
+    strict = find_knee(
+        measure,
+        50.0,
+        slo_p95_ms=100.0,
+        max_steps=8,
+        min_goodput_ratio=0.5,
+    )
+    assert strict.located
+    assert strict.knee_rate_per_sec <= 100.0
+
+
+# ---------------------------------------------------------------------------
+# Capacity artifact + diff gate
+# ---------------------------------------------------------------------------
+
+
+def _capacity_artifact(knee_rate):
+    measure = _synthetic_curve(capacity=knee_rate)
+    result = find_knee(
+        measure, 50.0, slo_p95_ms=100.0, max_steps=12, resolution=0.05
+    )
+    return artifact(
+        [
+            config_doc(
+                "pipelined-lan",
+                result,
+                profile="lan",
+                processor="pipelined",
+            )
+        ],
+        nodes=8,
+    )
+
+
+def test_capacity_artifact_schema_and_headline():
+    doc = _capacity_artifact(450.0)
+    assert doc["schema"] == SCHEMA
+    assert doc["knee_rate_per_sec"] == doc["configs"][0]["knee_rate_per_sec"]
+    assert doc["configs"][0]["located"]
+
+
+def test_diff_gates_injected_knee_regression(tmp_path):
+    """A knee that moves down >= threshold must fail ``obsv --diff``
+    (exit 1), both for a bare capacity artifact and for a bench payload
+    embedding one under "capacity"."""
+    good = _capacity_artifact(450.0)
+    bad = _capacity_artifact(220.0)  # injected regression: knee halved
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(good))
+    b.write_text(json.dumps(bad))
+
+    def run_diff(x, y):
+        return subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "mirbft_tpu.obsv",
+                "--diff",
+                str(x),
+                str(y),
+                "--threshold",
+                "10",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    proc = run_diff(a, b)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert any(
+        "knee_rate_per_sec" in r["series"] for r in verdict["regressions"]
+    )
+
+    # Equal artifacts pass.
+    proc = run_diff(a, a)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # Embedded in a bench payload under "capacity", same verdict.
+    pa = tmp_path / "pa.json"
+    pb = tmp_path / "pb.json"
+    pa.write_text(json.dumps({"metric": "x", "value": 1.0, "capacity": good}))
+    pb.write_text(json.dumps({"metric": "x", "value": 1.0, "capacity": bad}))
+    proc = run_diff(pa, pb)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert any(
+        r["series"].startswith("capacity.") for r in verdict["regressions"]
+    )

@@ -1122,3 +1122,42 @@ def test_lock_monitor_threading_proxy_forwards():
     event = proxy.Event()
     assert isinstance(event, threading.Event)
     assert proxy.current_thread() is threading.current_thread()
+
+
+def test_w19_queue_series_confined_to_bqueue_shim(tmp_path):
+    """W19: ``mirbft_queue_*`` series names are confined to
+    obsv/bqueue.py (the BoundedQueue/QueueTelemetry shim) and the
+    metrics catalog — an ad-hoc gauge elsewhere would bypass the
+    uniform depth/wait/saturation accounting the capacity rung's
+    attribution leans on."""
+    import lint
+
+    sneaky = tmp_path / "mirbft_tpu" / "runtime" / "sneaky_queue.py"
+    sneaky.parent.mkdir(parents=True)
+    sneaky.write_text(
+        "def emit(registry, n):\n"
+        "    registry.gauge('mirbft_queue_depth', queue='x').set(n)\n"
+    )
+    findings = lint.check_file(sneaky)
+    assert any("W19" in line for line in findings), findings
+
+    # Any literal carrying the prefix trips it, not just gauge calls.
+    renamed = tmp_path / "mirbft_tpu" / "app" / "sneaky2.py"
+    renamed.parent.mkdir(parents=True)
+    renamed.write_text("NAME = 'mirbft_queue_saturated_total'\n")
+    assert any("W19" in line for line in lint.check_file(renamed))
+
+    # The sanctioned owners, checked against the real sources.
+    for allowed in ("bqueue.py", "metrics.py"):
+        assert not any(
+            "W19" in line
+            for line in lint.check_file(
+                REPO / "mirbft_tpu" / "obsv" / allowed
+            )
+        ), allowed
+
+    # Outside the package tree (tests, tools) the rule does not apply.
+    harness = tmp_path / "tests" / "test_queues.py"
+    harness.parent.mkdir(parents=True)
+    harness.write_text("SERIES = 'mirbft_queue_depth'\n")
+    assert not any("W19" in line for line in lint.check_file(harness))

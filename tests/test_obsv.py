@@ -810,3 +810,236 @@ def test_diff_cli_verdicts(tmp_path):
     assert good.returncode == 0, good.stdout + good.stderr
     verdict = json.loads(good.stdout.strip().splitlines()[-1])
     assert verdict["ok"] is True and not verdict["regressions"]
+
+
+# ---------------------------------------------------------------------------
+# Bounded-queue backpressure telemetry (obsv/bqueue.py)
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_queue_emits_uniform_series():
+    """Depth/wait/saturation land under the three mirbft_queue_* names
+    with the queue label, and the queue keeps stdlib semantics."""
+    import queue as stdlib_queue
+
+    from mirbft_tpu.obsv.bqueue import BoundedQueue
+
+    try:
+        metrics, _ = hooks.enable()
+        q = BoundedQueue("test.stage", maxsize=2)
+        q.put("a")
+        q.put("b")
+        with pytest.raises(stdlib_queue.Full):
+            q.put("c", block=False)  # saturated attempt, still Full
+        assert q.get() == "a"
+        assert q.get_nowait() == "b"
+        with pytest.raises(stdlib_queue.Empty):
+            q.get_nowait()
+
+        snap = metrics.snapshot()
+        depth = {
+            tuple(s["labels"].items()): s["value"]
+            for s in snap["mirbft_queue_depth"]["series"]
+        }
+        assert depth[(("queue", "test.stage"),)] == 0  # after both gets
+        wait = snap["mirbft_queue_wait_seconds"]["series"][0]
+        assert wait["labels"] == {"queue": "test.stage"}
+        assert wait["count"] == 2  # both dequeues observed residency
+        sat = snap["mirbft_queue_saturated_total"]["series"][0]
+        assert sat["labels"] == {"queue": "test.stage"}
+        assert sat["value"] == 1
+    finally:
+        hooks.disable()
+
+
+def test_bounded_queue_disabled_is_silent_and_unstamped():
+    """With hooks off the queue must not touch any registry, and items
+    enqueued while off must not pollute the wait histogram after a
+    later enable (their residency spans the enable edge)."""
+    from mirbft_tpu.obsv.bqueue import BoundedQueue
+
+    assert not hooks.enabled
+    q = BoundedQueue("test.cold", maxsize=4)
+    q.put("cold")  # stamp 0.0: no clock read, no series
+    try:
+        metrics, _ = hooks.enable()
+        assert q.get() == "cold"
+        snap = metrics.snapshot()
+        waits = snap.get("mirbft_queue_wait_seconds", {}).get("series", [])
+        assert not any(
+            s["labels"] == {"queue": "test.cold"} and s["count"]
+            for s in waits
+        )
+        # The dequeue still updated depth — that is an honest instant.
+        depths = {
+            s["labels"]["queue"]: s["value"]
+            for s in snap["mirbft_queue_depth"]["series"]
+        }
+        assert depths.get("test.cold") == 0
+    finally:
+        hooks.disable()
+
+
+def test_queue_telemetry_rebinds_across_enable_cycles():
+    """A long-lived queue's handles follow the registry that hooks
+    currently carries (enable/disable/enable with a fresh registry)."""
+    from mirbft_tpu.obsv.bqueue import QueueTelemetry
+
+    telemetry = QueueTelemetry("test.longlived")
+    try:
+        first, _ = hooks.enable()
+        telemetry.saturated()
+        hooks.disable()
+        telemetry.saturated()  # off: dropped, no error
+        second, _ = hooks.enable()
+        telemetry.saturated()
+        get = lambda reg: [
+            s["value"]
+            for s in reg.snapshot()
+            .get("mirbft_queue_saturated_total", {})
+            .get("series", [])
+            if s["labels"] == {"queue": "test.longlived"}
+        ]
+        assert get(first) == [1]
+        assert get(second) == [1]
+    finally:
+        hooks.disable()
+
+
+def test_queue_telemetry_cardinality_degrades_not_crashes():
+    """A queue past the documented cardinality budget loses its series
+    (all three, atomically) but keeps queueing."""
+    from mirbft_tpu.obsv import metrics as metrics_mod
+    from mirbft_tpu.obsv.bqueue import BoundedQueue
+
+    saved = {
+        name: metrics_mod.CARDINALITY.get(name)
+        for name in (
+            "mirbft_queue_depth",
+            "mirbft_queue_wait_seconds",
+            "mirbft_queue_saturated_total",
+        )
+    }
+    metrics_mod.CARDINALITY["mirbft_queue_depth"] = 1
+    try:
+        metrics, _ = hooks.enable()
+        q_ok = BoundedQueue("test.within", maxsize=2)
+        q_over = BoundedQueue("test.over", maxsize=2)
+        q_ok.put(1)
+        q_over.put(2)  # over budget: series dropped, queue works
+        assert q_over.get() == 2
+        labels = {
+            s["labels"]["queue"]
+            for s in metrics.snapshot()["mirbft_queue_depth"]["series"]
+        }
+        assert labels == {"test.within"}
+    finally:
+        hooks.disable()
+        for name, value in saved.items():
+            metrics_mod.CARDINALITY[name] = value
+
+
+def test_hot_path_queues_ride_the_shim():
+    """Every bounded hot-path queue goes through the shim: the four
+    processor stage queues and the CommitStream apply queue are
+    BoundedQueues; the transport peer lanes and the device staging
+    buffer (whose data structures cannot be swapped) hold a bare
+    QueueTelemetry handle."""
+    import inspect
+
+    from mirbft_tpu import app, runtime
+    from mirbft_tpu.core import device_tracker
+    from mirbft_tpu.runtime import transport as transport_mod
+
+    proc_src = inspect.getsource(runtime.processor)
+    for stage in (
+        "proc.persist",
+        "proc.barrier",
+        "proc.transmit",
+        "proc.commit",
+    ):
+        assert f'BoundedQueue("{stage}"' in proc_src, stage
+    stream_src = inspect.getsource(app.stream)
+    assert 'BoundedQueue("app.apply"' in stream_src
+    transport_src = inspect.getsource(transport_mod)
+    assert "QueueTelemetry(" in transport_src
+    device_src = inspect.getsource(device_tracker)
+    assert 'QueueTelemetry("device.ack_stage")' in device_src
+
+
+# ---------------------------------------------------------------------------
+# Tracer open-flow table bound (the flow_milestone leak regression)
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_flow_table_bounded_eviction():
+    """Flows that never reach a terminal milestone (censored/dropped
+    requests) must not grow the open-flow table without bound; evictions
+    are counted on the tracer and the registry."""
+    try:
+        metrics, tracer = hooks.enable(trace=True)
+        tracer._max_open_flows = 4  # small bound for the test
+        for seq in range(10):
+            tracer.flow_milestone(
+                "seq.allocated", 0, seq, epoch=1, bucket=0
+            )
+        assert len(tracer._flows) == 4
+        assert tracer.abandoned_flows == 6
+        snap = metrics.snapshot()
+        assert (
+            snap["mirbft_flow_abandoned_total"]["series"][0]["value"] == 6
+        )
+        # Terminal milestones still close surviving flows normally.
+        tracer.flow_milestone("seq.committed", 0, 9)
+        assert (0, 9) not in tracer._flows
+    finally:
+        hooks.disable()
+
+
+def test_tracer_flow_eviction_without_registry_still_counts():
+    tracer = Tracer(max_open_flows=2)
+    for seq in range(5):
+        tracer.flow_milestone("seq.allocated", 0, seq, epoch=1, bucket=0)
+    assert len(tracer._flows) == 2
+    assert tracer.abandoned_flows == 3
+
+
+# ---------------------------------------------------------------------------
+# Bucket backlog gauges + imbalance in status
+# ---------------------------------------------------------------------------
+
+
+def test_imbalance_ratio_exact():
+    from mirbft_tpu.status import _imbalance_ratio
+
+    assert _imbalance_ratio([]) == 0.0
+    assert _imbalance_ratio([0, 0, 0, 0]) == 0.0
+    assert _imbalance_ratio([2, 2, 2, 2]) == 1.0
+    assert _imbalance_ratio([1, 2, 3, 10]) == 4.0  # median 2.5, max 10
+    assert _imbalance_ratio([0, 0, 0, 6]) == 6.0  # median floored at 1
+
+
+def test_bucket_backlog_gauges_and_status_fold():
+    """A seeded run exports mirbft_bucket_backlog per bucket, and the
+    status fold reports the backlog vector + imbalance ratio."""
+    from mirbft_tpu.status import state_machine_status
+    from mirbft_tpu.testengine.engine import BasicRecorder
+
+    try:
+        metrics, _ = hooks.enable()
+        rec = BasicRecorder(4, 2, 6, batch_size=2, seed=0, record=False)
+        rec.drain_clients(max_steps=1_000_000)
+        snap = metrics.snapshot()
+        series = snap["mirbft_bucket_backlog"]["series"]
+        assert series, "no bucket backlog gauges exported"
+        buckets = {s["labels"]["bucket"] for s in series}
+        assert len(buckets) == len(series)  # one series per bucket
+    finally:
+        hooks.disable()
+
+    status = state_machine_status(rec.machines[0])
+    assert status.bucket_backlog  # vector present (all committed -> 0s)
+    assert all(n == 0 for n in status.bucket_backlog)
+    assert status.bucket_imbalance == 0.0
+    assert "backlog:" in status.pretty()
+    assert "imbalance max/median" in status.pretty()

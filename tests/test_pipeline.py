@@ -168,10 +168,18 @@ def test_group_commit_crash_close_fails_uncovered_tickets(tmp_path):
     error, not a durability lie); clean close() covers them."""
     wal = FileWal(str(tmp_path / "wal"))
     wal.write(1, pb.Persistent(type=pb.ECEntry(epoch_number=1)))
-    block = threading.Event()
-    wal.fault_hook = lambda: block.wait(timeout=0.2)
+    # The sync must provably not cover the ticket, whichever side wins
+    # the scheduling race: if the syncer reaches the fsync first, the
+    # armed fault seam kills it (disk died — wait() raises the syncer's
+    # error); if crash() wins, the ticket is left uncovered by stop()
+    # (wait() raises the closed-before-sync error).  A fixed-length
+    # block here instead would flake on a loaded box — a sync that wins
+    # such a race really is durable, and wait() saying so is correct.
+    def dying_disk():
+        raise OSError("injected: disk died at fsync")
+
+    wal.fault_hook = dying_disk
     token = wal.sync_token()
-    wal.fault_hook = None
     wal.crash()
     with pytest.raises(OSError):
         wal.wait(token, timeout=5.0)

@@ -1,4 +1,4 @@
-"""General defect classes W1..W18 (the original tools/lint.py checks as
+"""General defect classes W1..W19 (the original tools/lint.py checks as
 Rule objects, message-compatible, plus the seeded-randomness ban and the
 adversary-tooling, resource-introspection, device-timing, and
 snapshot-I/O confinements).
@@ -58,6 +58,13 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   Storage owns the primitive, the app layer is its only caller; a call
   site anywhere else could persist app state without the applied-index
   coupling and silently break exactly-once apply.
+- W19 ``mirbft_queue_*`` series names outside ``obsv/bqueue.py`` (and
+  the catalog declarations in ``obsv/metrics.py``) — backpressure
+  telemetry for bounded hot-path queues flows through the BoundedQueue/
+  QueueTelemetry shim only, so every queue reports the same
+  depth/wait/saturation semantics; an ad-hoc gauge would fork the
+  meaning of "queue depth" per call site and silently bypass the
+  saturation accounting the capacity rung attributes against.
 """
 
 from __future__ import annotations
@@ -306,6 +313,23 @@ def in_app_state_io_ban_scope(posix: str) -> bool:
 # plane (dense bitmask state + popcount quorum kernels).  Everything else
 # in core/ is the purity auditor's deterministic root set.
 CORE_JAX_ALLOWED_FILE = "mirbft_tpu/core/device_tracker.py"
+
+
+# The only emission point for bounded-queue backpressure series: the
+# BoundedQueue/QueueTelemetry shim.  metrics.py is allowed too — the
+# catalog must declare the family names as literals.
+QUEUE_SERIES_PREFIX = "mirbft_queue_"
+QUEUE_SERIES_ALLOWED_FILES = (
+    "mirbft_tpu/obsv/bqueue.py",
+    "mirbft_tpu/obsv/metrics.py",
+)
+
+
+def in_queue_series_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W19 bans mirbft_queue_* literals."""
+    return "mirbft_tpu/" in posix and not any(
+        posix.endswith(allowed) for allowed in QUEUE_SERIES_ALLOWED_FILES
+    )
 
 
 def in_core_jax_ban_scope(posix: str) -> bool:
@@ -825,6 +849,23 @@ def _check_w18(ctx: FileContext):
                 yield Finding("W18", ctx.path, node.lineno, msg)
 
 
+def _check_w19(ctx: FileContext):
+    msg = (
+        "mirbft_queue_* series emitted outside the obsv/bqueue.py shim "
+        "(bounded-queue depth/wait/saturation telemetry must flow "
+        "through BoundedQueue/QueueTelemetry so every queue shares the "
+        "same semantics; an ad-hoc gauge bypasses the saturation "
+        "accounting the capacity rung attributes against)"
+    )
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith(QUEUE_SERIES_PREFIX)
+        ):
+            yield Finding("W19", ctx.path, node.lineno, msg)
+
+
 def _as_list(gen_fn):
     def check(ctx):
         return list(gen_fn(ctx))
@@ -1027,6 +1068,22 @@ register(
         ),
         check=_as_list(_check_w18),
         scope=in_app_state_io_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W19",
+        title="mirbft_queue_* series outside the bqueue shim",
+        doc=(
+            "Bounded hot-path queue telemetry (mirbft_queue_depth / "
+            "mirbft_queue_wait_seconds / mirbft_queue_saturated_total) is "
+            "emitted only by obsv/bqueue.py (metrics.py may declare the "
+            "names in the catalog); every queue must share the shim's "
+            "depth/wait/saturation semantics rather than minting ad-hoc "
+            "gauges."
+        ),
+        check=_as_list(_check_w19),
+        scope=in_queue_series_ban_scope,
     )
 )
 register(
