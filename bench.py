@@ -143,6 +143,17 @@ LIVE_MP_STEP_DURATION_S = 2.0
 LIVE_MP_DRAIN_S = 25.0
 LIVE_MP_BATCH_SIZE = 4
 
+# Reconfig A/B inside the mp rung (docs/RECONFIG.md): one Poisson rate
+# measured twice on the same cluster — steady state, then again while a
+# committed add-node reconfiguration adopts at the checkpoint boundary
+# and the joiner boots via snapshot transfer.  The delta (goodput down,
+# p95 up) prices the adoption reinitialize + epoch roll + joiner
+# catch-up; both steps ride the same SLO artifact obsv --diff gates.
+LIVE_MP_RECONFIG_RATE = 25.0
+LIVE_MP_RECONFIG_STEP_S = 4.0
+LIVE_MP_RECONFIG_ADMIN_CLIENT = 9
+LIVE_MP_RECONFIG_CI = 5
+
 # App rung: the replicated KV service's user-visible read/write SLOs
 # (docs/APP.md) on an 8-process cluster — every op goes through the
 # socket service: writes pay propose → consensus → apply → waiter
@@ -1224,6 +1235,105 @@ def live_mp_run(kind: str):
         supervisor.teardown()
 
 
+def reconfig_run():
+    """Membership-change A/B on the mp cluster (docs/RECONFIG.md): the
+    same open-loop Poisson step measured twice — in steady state, then
+    while an admin client's committed ``pb.NetworkConfig`` grows the
+    node set 4 -> 5, the incumbents adopt at the checkpoint boundary,
+    and the joiner boots with the committed target config and catches
+    up via snapshot transfer.  Returns ``(steps, evidence)`` where
+    ``steps`` are the two loadgen StepResults (they join the mp SLO
+    artifact) and ``evidence`` carries adoption/join counters so the
+    A/B cannot pass vacuously."""
+    from mirbft_tpu import loadgen, pb
+    from mirbft_tpu.cluster import ClusterSupervisor
+    from mirbft_tpu.cluster.worker import read_json
+    from mirbft_tpu.runtime.reconfig import encode_reconfig_request
+
+    client_ids = [1, 2, 3]
+    admin = LIVE_MP_RECONFIG_ADMIN_CLIENT
+    incumbent = {
+        "nodes": [0, 1, 2, 3],
+        "f": 1,
+        "number_of_buckets": 4,
+        "checkpoint_interval": LIVE_MP_RECONFIG_CI,
+        "max_epoch_length": 10 * LIVE_MP_RECONFIG_CI,
+    }
+    target = dict(incumbent, nodes=[0, 1, 2, 3, 4])
+    reconfig_payload = encode_reconfig_request(
+        [pb.Reconfiguration(type=pb.NetworkConfig(**target))]
+    )
+    supervisor = ClusterSupervisor(
+        node_count=5,
+        client_ids=client_ids + [admin],
+        batch_size=LIVE_MP_BATCH_SIZE,
+        processor="serial",
+        deferred_nodes=(4,),
+        network_config=incumbent,
+    )
+    evidence = {"adoptions": 0, "joined": False}
+    stop = threading.Event()
+
+    def reconfigure():
+        # Submit (resubmitting until adoption — client-window dedup
+        # absorbs duplicates), then spawn the joiner with the committed
+        # target config the moment any incumbent reports adoption.
+        request = pb.Request(client_id=admin, req_no=0, data=reconfig_payload)
+        last_submit = 0.0
+        while not stop.is_set():
+            adopted = 0
+            for node in incumbent["nodes"]:
+                doc = read_json(
+                    os.path.join(supervisor.nodes[node].dir, "reconfig.json")
+                )
+                adopted += int((doc or {}).get("adopted", 0) or 0)
+            evidence["adoptions"] = adopted
+            if adopted > 0:
+                supervisor.join_node(4, network_config=target)
+                evidence["joined"] = True
+                return
+            if time.monotonic() - last_submit >= 1.0:
+                for node_id in supervisor.alive_nodes():
+                    supervisor.submit(node_id, request)
+                last_submit = time.monotonic()
+            time.sleep(0.2)
+
+    try:
+        supervisor.start()
+        generator = loadgen.LoadGenerator(
+            supervisor,
+            loadgen.standard_client_models(client_ids),
+            seed=13,
+        )
+        steady = generator.run_step(
+            "reconfig-steady",
+            loadgen.PoissonArrivals(LIVE_MP_RECONFIG_RATE, seed=7),
+            duration_s=LIVE_MP_RECONFIG_STEP_S,
+            drain_s=LIVE_MP_DRAIN_S,
+        )
+        worker = threading.Thread(target=reconfigure, daemon=True)
+        worker.start()
+        during = generator.run_step(
+            "reconfig-add-node",
+            loadgen.PoissonArrivals(LIVE_MP_RECONFIG_RATE, seed=8),
+            duration_s=LIVE_MP_RECONFIG_STEP_S,
+            # Longer drain than the steady arm: the adoption epoch roll
+            # can spiral on a starved CPU and commit resumption then
+            # takes tens of seconds; a timed-out tail here would report
+            # a liveness failure as a latency number.
+            drain_s=4 * LIVE_MP_DRAIN_S,
+        )
+        worker.join(timeout=90.0)
+        assert evidence["adoptions"] > 0, (
+            "reconfig A/B is vacuous: no incumbent adopted the "
+            "reconfiguration within the measurement window"
+        )
+        return [steady, during], evidence
+    finally:
+        stop.set()
+        supervisor.teardown()
+
+
 def app_run():
     """KV service SLO rung: APP_SESSIONS closed-loop sessions drive
     mixed reads/writes through the replicated KV service's sockets on an
@@ -1993,6 +2103,12 @@ def main() -> int:
     if mp_pipelined is not None:
         steps, mp_pipelined_goodput, mp_pipelined_p95 = mp_pipelined
         mp_steps.extend(steps)
+    mp_reconfig = runner.run("live_mp_reconfig", reconfig_run)
+    reconfig_steady = reconfig_during = None
+    reconfig_evidence = {}
+    if mp_reconfig is not None:
+        (reconfig_steady, reconfig_during), reconfig_evidence = mp_reconfig
+        mp_steps.extend([reconfig_steady, reconfig_during])
     app_steps = runner.run("app_kv", app_run) or []
     app_top = app_steps[-1] if app_steps else None
     capacity = runner.run("knee", knee_run)
@@ -2149,6 +2265,32 @@ def main() -> int:
             f"x {LIVE_MP_STEP_DURATION_S:.0f}s, "
             f"batch_size={LIVE_MP_BATCH_SIZE}, client mix: honest + "
             "slow/mixed-size + retry-storm"
+        ),
+        # Reconfig A/B (docs/RECONFIG.md): the same Poisson rate in
+        # steady state vs while an add-node reconfiguration commits,
+        # adopts, and the joiner catches up; the dip is the price of
+        # membership change under load.  Both steps also ride the
+        # "loadgen" SLO artifact as reconfig-steady / reconfig-add-node.
+        "reconfig_steady_goodput_per_sec": _round(
+            reconfig_steady.goodput_per_sec if reconfig_steady else None
+        ),
+        "reconfig_steady_p95_ms": _round(
+            reconfig_steady.p95_ms if reconfig_steady else None, 2
+        ),
+        "reconfig_window_goodput_per_sec": _round(
+            reconfig_during.goodput_per_sec if reconfig_during else None
+        ),
+        "reconfig_window_p95_ms": _round(
+            reconfig_during.p95_ms if reconfig_during else None, 2
+        ),
+        "reconfig_adoptions": reconfig_evidence.get("adoptions"),
+        "reconfig_joiner_booted": reconfig_evidence.get("joined"),
+        "reconfig_config": (
+            f"4 -> 5 nodes via a committed pb.NetworkConfig from admin "
+            f"client {LIVE_MP_RECONFIG_ADMIN_CLIENT}, ci="
+            f"{LIVE_MP_RECONFIG_CI}, Poisson "
+            f"{int(LIVE_MP_RECONFIG_RATE)} req/s x "
+            f"{LIVE_MP_RECONFIG_STEP_S:.0f}s per arm"
         ),
         # App rung: the replicated KV service's user-visible SLOs — the
         # read/write latency split and goodput through the app sockets

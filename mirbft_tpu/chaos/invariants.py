@@ -358,6 +358,67 @@ def check_bounded_recovery(
         )
 
 
+def check_config_agreement(
+    checkpoint_configs: dict, final_configs: dict, adoptions: int
+) -> dict:
+    """Dynamic membership never splits the configuration: no two correct
+    nodes certify divergent network configs at the same checkpoint
+    sequence number, and every correct survivor converges to the same
+    final active config.
+
+    Engine-agnostic evidence:
+
+    - ``checkpoint_configs``: {node: {seq_no: config_bytes}} — the
+      ``pb.encode``'d NetworkConfig each node bound into its checkpoint
+      at each stable seq (the deterministic runner reads
+      ``NodeState.checkpoints``, the live driver each worker's
+      checkpoints.jsonl).
+    - ``final_configs``: {node: config_bytes} — each correct survivor's
+      active config at drain end.
+    - ``adoptions``: total reconfiguration-adoption events observed
+      across nodes (``reconfigs_adopted`` / reconfig.json evidence).
+
+    Vacuity guard: at least one adoption must have been observed —
+    otherwise no reconfiguration ever activated and agreement is
+    trivially true.  Returns tally evidence."""
+    if adoptions < 1:
+        raise InvariantViolation(
+            "reconfig scenario adopted no reconfiguration (vacuous): "
+            "config agreement proves nothing"
+        )
+    canonical: dict = {}  # seq -> (config_bytes, node)
+    compared = 0
+    for node in sorted(checkpoint_configs):
+        for seq, config in sorted(checkpoint_configs[node].items()):
+            prior = canonical.get(seq)
+            if prior is None:
+                canonical[seq] = (config, node)
+            else:
+                compared += 1
+                if prior[0] != config:
+                    raise InvariantViolation(
+                        f"config fork at checkpoint seq {seq}: node "
+                        f"{prior[1]} certified {prior[0].hex()}, node "
+                        f"{node} certified {config.hex()}"
+                    )
+    finals = {}
+    for node, config in sorted(final_configs.items()):
+        finals.setdefault(config, []).append(node)
+    if len(finals) > 1:
+        groups = {
+            cfg.hex(): nodes for cfg, nodes in sorted(finals.items())
+        }
+        raise InvariantViolation(
+            f"correct survivors diverge on the final active config: "
+            f"{groups}"
+        )
+    return {
+        "adoptions": adoptions,
+        "checkpoints_compared": compared,
+        "survivors": len(final_configs),
+    }
+
+
 def check_linearizable_reads(history: list) -> dict:
     """Reads over the replicated KV never go backwards or observe forks.
 

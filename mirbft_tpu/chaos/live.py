@@ -64,6 +64,7 @@ from ..runtime import (
     build_processor,
 )
 from ..runtime.node import NodeStopped, standard_initial_network_state
+from ..runtime.reconfig import checkpoint_network_state
 from ..runtime.transfer import _KIND_CHUNK, TransferEngine
 from ..runtime.transport import (
     _HELLO_SRC,
@@ -503,11 +504,7 @@ class LiveReplica:
 
     def _capture_checkpoints(self, results) -> None:
         for cr in results.checkpoints:
-            network_state = pb.NetworkState(
-                config=cr.checkpoint.network_config,
-                clients=cr.checkpoint.clients_state,
-                pending_reconfigurations=list(cr.reconfigurations),
-            )
+            network_state = checkpoint_network_state(cr)
             self.checkpoints[cr.checkpoint.seq_no] = (cr.value, network_state)
             requests: list = []
 

@@ -12,6 +12,7 @@ import os
 import tempfile
 from dataclasses import dataclass, field
 
+from .. import pb
 from ..obsv import hooks
 from ..obsv.metrics import Registry
 from ..obsv.recorder import FlightRecorder
@@ -22,6 +23,7 @@ from .invariants import (
     check_bounded_recovery,
     check_censorship_liveness,
     check_commit_resumption,
+    check_config_agreement,
     check_corruption_rejected,
     check_durable_prefix,
     check_flood_bounded,
@@ -145,6 +147,16 @@ def dump_on_violation(recorder, scenario_name, seed, violation) -> str:
         return ""
 
 
+def _active_config(rec, node):
+    """The node's currently-active NetworkConfig, or None before its
+    commit state initializes (deferred/booting nodes)."""
+    machine = rec.machines.get(node)
+    commit_state = getattr(machine, "commit_state", None)
+    if commit_state is None or commit_state.active_state is None:
+        return None
+    return commit_state.active_state.config
+
+
 def run_scenario(
     scenario: Scenario, seed: int = 0, registry: Registry | None = None
 ) -> ScenarioResult:
@@ -184,7 +196,57 @@ def run_scenario(
             scenario.network_state() if scenario.network_state else None
         ),
         record=False,
+        deferred_nodes=scenario.deferred_nodes,
     )
+
+    # Committed-reconfiguration triggers: the app model reports the
+    # payloads when the trigger request commits; the runner then owns the
+    # operator-side half — provisioning joined nodes from a reconfigured
+    # checkpoint and registering reconfiguration-added clients once the
+    # new config is active somewhere.
+    for point in scenario.reconfigs:
+        rec.reconfig_on_commit[(point.client_id, point.req_no)] = point.build()
+    joins_pending = [
+        (node, point) for point in scenario.reconfigs for node in point.joins
+    ]
+    clients_pending = [
+        (cid, total)
+        for point in scenario.reconfigs
+        for cid, total in point.add_clients
+    ]
+
+    def service_reconfigs() -> None:
+        for node, point in list(joins_pending):
+            if rec.node_states[point.provision_from].crashed:
+                continue
+            config = _active_config(rec, point.provision_from)
+            if config is None or node not in config.nodes:
+                continue
+            seq = None
+            checkpoints = rec.node_states[point.provision_from].checkpoints
+            for cp_seq, (_v, state, _snap) in checkpoints.items():
+                if node in state.config.nodes and (
+                    seq is None or cp_seq > seq
+                ):
+                    seq = cp_seq
+            if seq is None:
+                continue
+            rec.provision_node(
+                node, point.provision_from, seq, point.provision_delay_ms
+            )
+            joins_pending.remove((node, point))
+        for cid, total in list(clients_pending):
+            for member in range(rec.node_count):
+                if rec.node_states[member].crashed:
+                    continue
+                config_state = _active_config(rec, member)
+                if config_state is None:
+                    continue
+                clients = rec.machines[member].commit_state.active_state.clients
+                if any(c.id == cid for c in clients):
+                    rec.add_client(cid, total)
+                    clients_pending.remove((cid, total))
+                    break
 
     pending = sorted(scenario.crashes, key=lambda c: c.at_ms)
     snapshots: list = []
@@ -252,13 +314,18 @@ def run_scenario(
         check = True
         for _ in range(scenario.max_steps):
             fire_due_crashes()
+            if joins_pending or clients_pending:
+                service_reconfigs()
             if check or rec._progress:
                 check = False
                 # fully_committed ignores crashed nodes; a scenario only
-                # completes once every scheduled crash has fired AND every
+                # completes once every scheduled crash has fired, every
+                # reconfiguration-joined node is provisioned and every
                 # node is back up and caught up.
                 if (
                     not pending
+                    and not joins_pending
+                    and not clients_pending
                     and rec.fully_committed()
                     and not any(
                         rec.node_states[n].crashed
@@ -319,6 +386,33 @@ def run_scenario(
                     "scenario expected an epoch change but every node is "
                     f"still in the boot epoch (epochs {epochs})"
                 )
+        if scenario.reconfigs:
+            adoptions = 0
+            checkpoint_configs: dict = {}
+            final_configs: dict = {}
+            for node in range(rec.node_count):
+                machine = rec.machines[node]
+                adoptions += getattr(machine, "reconfigs_adopted", 0)
+                checkpoint_configs[node] = {
+                    seq: pb.encode(state.config)
+                    for seq, (_v, state, _snap) in rec.node_states[
+                        node
+                    ].checkpoints.items()
+                }
+                config = _active_config(rec, node)
+                if (
+                    config is not None
+                    and not rec.node_states[node].crashed
+                    and not getattr(machine, "retired", False)
+                ):
+                    final_configs[node] = pb.encode(config)
+            evidence = check_config_agreement(
+                checkpoint_configs, final_configs, adoptions
+            )
+            result.counters["reconfig_adoptions"] = adoptions
+            result.counters["config_checkpoints"] = evidence[
+                "checkpoints_compared"
+            ]
         _audit_adversaries(
             scenario, rec, manglers, commit_rotations, registry, result
         )

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from .. import pb
 from ..resilience import CircuitBreaker
 from ..testengine.crypto_plane import CoalescingHashPlane
 from ..testengine.engine import standard_initial_network_state
@@ -79,6 +80,11 @@ class NodeJoin:
     at_ms: int
     node: int
     catchup_bound_ms: int = 60_000
+    # When True the joiner is admitted by a committed pb.Reconfiguration
+    # (the mp driver submits the grown config through the ordered
+    # broadcast and only spawns the node once an incumbent has adopted
+    # it) rather than by a static provisioned spec.
+    via_reconfig: bool = False
 
 
 @dataclass(frozen=True)
@@ -89,6 +95,34 @@ class NodeRemoval:
 
     at_ms: int
     node: int
+    # When True the survivors also commit a pb.Reconfiguration that
+    # shrinks the config to exclude ``node``; the departure is a
+    # membership change, not just a silent crash.
+    via_reconfig: bool = False
+
+
+@dataclass(frozen=True)
+class ReconfigPoint:
+    """A reconfiguration riding the ordered broadcast (deterministic
+    engine): when request ``(client_id, req_no)`` commits, every node's
+    app observes ``build()``'s ``pb.Reconfiguration`` list and reports
+    it with its next checkpoint; the new config activates at the next
+    stable checkpoint (commitstate's pending -> reconfigured seam).
+
+    ``joins`` names deferred nodes the runner provisions — from
+    ``provision_from``'s newest stable checkpoint whose config includes
+    them — once the grown config is *active* at that member (the
+    operator-side half of a node-set reconfiguration).  ``add_clients``
+    are ``(client_id, total_reqs)`` pairs registered with the engine
+    once the adopted config's client set carries them."""
+
+    client_id: int
+    req_no: int
+    build: object  # zero-arg factory -> [pb.Reconfiguration]
+    joins: tuple = ()
+    provision_from: int = 0
+    provision_delay_ms: int = 50
+    add_clients: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -206,11 +240,85 @@ def _rotating_network_state(
     rebooted node fall a full certified checkpoint behind quickly."""
 
     def build():
-        state = standard_initial_network_state(node_count, list(client_ids))
-        state.config.max_epoch_length = max_epoch_length
-        if checkpoint_interval:
-            state.config.checkpoint_interval = checkpoint_interval
-        return state
+        base = standard_initial_network_state(node_count, list(client_ids))
+        # Construct the variant config rather than mutating the standard
+        # one in place: NetworkConfig mutation outside the adoption seam
+        # is banned (lint rule W20) because live trackers alias it.
+        return pb.NetworkState(
+            config=pb.NetworkConfig(
+                nodes=list(base.config.nodes),
+                f=base.config.f,
+                number_of_buckets=base.config.number_of_buckets,
+                checkpoint_interval=(
+                    checkpoint_interval or base.config.checkpoint_interval
+                ),
+                max_epoch_length=max_epoch_length,
+            ),
+            clients=base.clients,
+        )
+
+    return build
+
+
+def _grow_network_state():
+    """Factory for the node-set-growth universe: 4 active members (0..3)
+    of a 5-node simulated universe, short checkpoint windows so adoption
+    lands early, client widths covering the whole request stream (the
+    deterministic engine submits each request exactly once)."""
+
+    def build():
+        return pb.NetworkState(
+            config=pb.NetworkConfig(
+                nodes=[0, 1, 2, 3],
+                f=1,
+                number_of_buckets=4,
+                checkpoint_interval=8,
+                max_epoch_length=16,
+            ),
+            clients=[
+                pb.NetworkClient(id=cid, width=48, low_watermark=0)
+                for cid in (5, 6)
+            ],
+        )
+
+    return build
+
+
+def _five_node_reconfig():
+    """The committed grow payload: the 5-node config node 4 joins under.
+    Bucket count stays at 4 so in-flight bucket ownership is stable
+    across the flip; only membership/f change."""
+    return [
+        pb.Reconfiguration(
+            type=pb.NetworkConfig(
+                nodes=[0, 1, 2, 3, 4],
+                f=1,
+                number_of_buckets=4,
+                checkpoint_interval=8,
+                max_epoch_length=16,
+            )
+        )
+    ]
+
+
+def _mel_reconfig(max_epoch_length: int):
+    """A full-replacement NetworkConfig payload differing from the
+    4-node standard config only in ``max_epoch_length`` — the benign
+    knob the equivocating-configs scenario uses to build a *conflicting
+    pair* without destabilizing watermarks or bucket maps mid-run."""
+
+    def build():
+        return [
+            pb.Reconfiguration(
+                type=pb.NetworkConfig(
+                    nodes=[0, 1, 2, 3],
+                    f=1,
+                    number_of_buckets=4,
+                    checkpoint_interval=20,
+                    max_epoch_length=max_epoch_length,
+                )
+            )
+        ]
 
     return build
 
@@ -234,6 +342,13 @@ class Scenario:
     storage_faults: tuple = ()  # StorageFaults (live driver only)
     joins: tuple = ()  # NodeJoins (mp driver only)
     removes: tuple = ()  # NodeRemovals (mp driver only)
+    # Committed-reconfiguration triggers (deterministic engine): the
+    # runner wires each onto Recorder.reconfig_on_commit, provisions
+    # the joined nodes after adoption, and audits config agreement.
+    reconfigs: tuple = ()  # ReconfigPoints
+    # Nodes in the simulated universe that boot only after a node-set
+    # reconfiguration adds them (paired with ReconfigPoint.joins).
+    deferred_nodes: tuple = ()
     # Signed-request mode: clients Ed25519-sign, replicas verify at
     # ingress through a SignaturePlane (factory below, fresh per run).
     signed: bool = False
@@ -668,12 +783,129 @@ def matrix() -> list:
             reqs_per_client=120,
             tags=("device", "signed", "live"),
         ),
+        # -- dynamic membership (committed reconfigurations) ---------------
+        Scenario(
+            name="reconfig-add-node",
+            description="a committed NetworkConfig reconfiguration grows "
+            "the replica set 4 -> 5 at a checkpoint boundary; node 4 is "
+            "provisioned from a member's reconfigured checkpoint and "
+            "commits the tail of the workload as a full member",
+            node_count=5,
+            client_count=2,
+            reqs_per_client=40,
+            batch_size=2,
+            network_state=_grow_network_state(),
+            deferred_nodes=(4,),
+            reconfigs=(
+                ReconfigPoint(
+                    client_id=5,
+                    req_no=2,
+                    build=_five_node_reconfig,
+                    joins=(4,),
+                ),
+            ),
+            recovery_bound_ms=300_000,
+            max_steps=2_000_000,
+            tags=("reconfig",),
+        ),
+        Scenario(
+            name="reconfig-crash-straddle",
+            description="the 4 -> 5 grow again, with member 1 crashing "
+            "around the adoption window and replaying the "
+            "C(pending)+C(reconfigured) pair from its WAL",
+            node_count=5,
+            client_count=2,
+            reqs_per_client=40,
+            batch_size=2,
+            network_state=_grow_network_state(),
+            deferred_nodes=(4,),
+            reconfigs=(
+                ReconfigPoint(
+                    client_id=5,
+                    req_no=2,
+                    build=_five_node_reconfig,
+                    joins=(4,),
+                ),
+            ),
+            crashes=(CrashPoint(at_ms=2000, node=1, restart_delay_ms=3000),),
+            recovery_bound_ms=300_000,
+            max_steps=2_000_000,
+            tags=("reconfig",),
+        ),
+        Scenario(
+            name="reconfig-partition-flip",
+            description="a 2-2 split of the incumbents spans the config "
+            "flip: the reconfiguration can only stabilize after the heal, "
+            "and the joiner provisions from the post-heal checkpoint",
+            node_count=5,
+            client_count=2,
+            reqs_per_client=40,
+            batch_size=2,
+            network_state=_grow_network_state(),
+            deferred_nodes=(4,),
+            reconfigs=(
+                ReconfigPoint(
+                    client_id=5,
+                    req_no=2,
+                    build=_five_node_reconfig,
+                    joins=(4,),
+                ),
+            ),
+            partitions=(
+                PartitionWindow(
+                    groups=((0, 1), (2, 3, 4)), from_ms=1000, until_ms=5000
+                ),
+            ),
+            recovery_bound_ms=300_000,
+            max_steps=2_000_000,
+            tags=("reconfig",),
+        ),
+        Scenario(
+            name="reconfig-equivocate-configs",
+            description="two conflicting NetworkConfig payloads (differing "
+            "max_epoch_length) commit in total order while leader 0 "
+            "equivocates Preprepares to followers 1 and 2 — no pair of "
+            "correct nodes may adopt divergent configs at any checkpoint, "
+            "and all must converge on the final (last-committed) config",
+            reqs_per_client=30,
+            reconfigs=(
+                ReconfigPoint(
+                    client_id=4, req_no=3, build=_mel_reconfig(40)
+                ),
+                ReconfigPoint(
+                    client_id=5, req_no=3, build=_mel_reconfig(80)
+                ),
+            ),
+            adversaries=(
+                Adversary(
+                    kind="equivocate", node=0, victims=(1, 2), until_ms=3000
+                ),
+            ),
+            expect_epoch_change=True,
+            heal_points_ms=(3000,),
+            recovery_bound_ms=300_000,
+            max_steps=2_000_000,
+            tags=("reconfig", "adversary"),
+        ),
     ]
 
 
 # The tier-1 smoke subset: one partition-with-heal, one crash-with-
-# restart, one device-plane failure — the three disruption families.
-SMOKE_NAMES = ("partition-minority", "crash-restart", "device-digest-dies")
+# restart, one device-plane failure, one committed node-set
+# reconfiguration — the four disruption families.
+SMOKE_NAMES = (
+    "partition-minority",
+    "crash-restart",
+    "device-digest-dies",
+    "reconfig-add-node",
+)
+
+
+def reconfig_matrix() -> list:
+    """The dynamic-membership subset of the matrix (committed
+    reconfigurations under crashes/partitions/equivocation), selected by
+    ``chaos --reconfig``."""
+    return [s for s in matrix() if "reconfig" in s.tags]
 
 
 def smoke_matrix() -> list:

@@ -29,6 +29,7 @@ rejected rather than silently weakened.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import threading
@@ -43,10 +44,12 @@ from ..chaos.invariants import (
     check_bounded_catchup,
     check_bounded_recovery,
     check_commit_resumption,
+    check_config_agreement,
     check_durable_prefix,
     check_linearizable_reads,
     check_no_fork,
 )
+from ..runtime.reconfig import encode_reconfig_request
 from ..chaos.live import MIN_RECOVERY_BOUND_MS, SIM_TICK_MS
 from ..chaos.runner import CampaignResult, ScenarioResult
 from ..chaos.scenarios import (
@@ -173,13 +176,126 @@ def remove_under_partition_scenario() -> Scenario:
     )
 
 
-MP_RECONFIG_NAMES = ("join-under-partition", "remove-under-partition")
+def reconfig_add_under_partition_scenario() -> Scenario:
+    """Dynamic membership, the add half: the 4 incumbents boot with a
+    genesis config that does NOT include node 4.  An admin client then
+    submits a ``pb.Reconfiguration`` carrying the grown 5-node config
+    through the ordered broadcast; only once an incumbent's published
+    reconfig counters show the config *adopted* (stable reconfigured
+    checkpoint) does the driver spawn node 4 — booted with the exact
+    target config the committed op carried, never a static roster.  A
+    2-2 incumbent partition spans the config flip: while it holds no
+    quorum exists, so adoption itself must ride out the cut.  The
+    joiner still owes the usual evidence: bounded catch-up plus
+    ``snapshots_installed >= 1``, and ``check_config_agreement`` audits
+    every certified checkpoint config byte-for-byte across nodes."""
+    return Scenario(
+        name="reconfig-add-under-partition",
+        description=(
+            "a committed Reconfiguration grows the cluster 4 -> 5 while "
+            "a 2-2 incumbent partition spans the config flip; node 4 "
+            "joins only after adoption, catches up via certified "
+            "snapshot, and no two nodes ever certify divergent configs"
+        ),
+        node_count=5,
+        client_count=2,
+        reqs_per_client=6,
+        joins=(
+            NodeJoin(
+                at_ms=2000,
+                node=4,
+                catchup_bound_ms=150_000,
+                via_reconfig=True,
+            ),
+        ),
+        partitions=(
+            PartitionWindow(
+                groups=((0, 1), (2, 3, 4)),
+                from_ms=12_500,
+                until_ms=37_500,
+            ),
+        ),
+        notes={"checkpoint_interval": 5},
+        recovery_bound_ms=300_000,
+        tags=("mp", "reconfig"),
+    )
+
+
+def reconfig_remove_leader_crash_scenario() -> Scenario:
+    """Dynamic membership, the remove half: leader 3 is killed (true
+    kill -9, never restarted) and the survivors commit a
+    ``pb.Reconfiguration`` shrinking the config to exclude it.  The
+    3-node quorum must first ride the leader crash (epoch change to
+    strip the dead leader's buckets), then adopt the shrunk config at a
+    stable checkpoint and keep committing under it — the departure is a
+    membership change the protocol agrees on, not just a silent hole in
+    the mesh.  The corpse's durable log must stay a clean prefix, and
+    ``check_config_agreement`` holds every shared checkpoint config
+    byte-identical across survivors and corpse alike."""
+    return Scenario(
+        name="reconfig-remove-leader-crash",
+        description=(
+            "leader 3 crashes for good and the survivors commit a "
+            "Reconfiguration removing it; commits resume under the "
+            "adopted 3-node config within the liveness bound"
+        ),
+        node_count=4,
+        client_count=2,
+        reqs_per_client=6,
+        removes=(NodeRemoval(at_ms=12_500, node=3, via_reconfig=True),),
+        notes={"checkpoint_interval": 5},
+        recovery_bound_ms=300_000,
+        tags=("mp", "reconfig"),
+    )
+
+
+MP_RECONFIG_NAMES = (
+    "join-under-partition",
+    "remove-under-partition",
+    "reconfig-add-under-partition",
+    "reconfig-remove-leader-crash",
+)
 
 
 def mp_reconfig_matrix() -> list:
-    """The reconfiguration-under-fire pair (mp-only: joining means
-    spawning a real OS process against a live mesh)."""
-    return [join_under_partition_scenario(), remove_under_partition_scenario()]
+    """The reconfiguration-under-fire set (mp-only: joining means
+    spawning a real OS process against a live mesh): the static-roster
+    pair, then the committed-Reconfiguration pair."""
+    return [
+        join_under_partition_scenario(),
+        remove_under_partition_scenario(),
+        reconfig_add_under_partition_scenario(),
+        reconfig_remove_leader_crash_scenario(),
+    ]
+
+
+def _reconfig_target(scenario: Scenario) -> tuple:
+    """The (incumbent, target) config dicts for a via_reconfig scenario.
+
+    The incumbent config is the genesis every booted member starts
+    from: the provisioned node set minus deferred joiners.  The target
+    is what the committed ``pb.Reconfiguration`` carries: plus the
+    joiners, minus the removed.  Bucket count is pinned to the
+    incumbent width so the request->bucket mapping survives the flip."""
+    nodes = list(range(scenario.node_count))
+    joining = {j.node for j in scenario.joins if j.via_reconfig}
+    removing = {r.node for r in scenario.removes if r.via_reconfig}
+    incumbents = [n for n in nodes if n not in joining]
+    target = [n for n in nodes if n not in removing]
+    buckets = len(incumbents)
+    ci = int(scenario.notes.get("checkpoint_interval") or 5 * buckets)
+    mel = 10 * ci
+
+    def cfg(members: list) -> dict:
+        return {
+            "nodes": list(members),
+            "f": (len(members) - 1) // 3,
+            "number_of_buckets": buckets,
+            "checkpoint_interval": ci,
+            "max_epoch_length": mel,
+        }
+
+    return cfg(incumbents), cfg(target)
 
 
 def mp_matrix() -> list:
@@ -272,15 +388,59 @@ class _MpDriver:
         )
         kv_base = max(self.clients, default=0) + 1
         self.kv_client_ids = list(range(kv_base, kv_base + kv_sessions))
+        # Dynamic membership (via_reconfig joins/removes): the admin
+        # client submits the target config through the ordered
+        # broadcast; incumbents boot with a genesis that excludes the
+        # joiners, so the only way the member set can change is the
+        # committed pb.Reconfiguration.
+        self.reconfig_incumbent = None
+        self.reconfig_target = None
+        self.reconfig_payload = None
+        self.admin_client_id = None
+        admin_ids: list = []
+        if any(j.via_reconfig for j in scenario.joins) or any(
+            r.via_reconfig for r in scenario.removes
+        ):
+            self.reconfig_incumbent, self.reconfig_target = _reconfig_target(
+                scenario
+            )
+            self.reconfig_payload = encode_reconfig_request(
+                [
+                    pb.Reconfiguration(
+                        type=pb.NetworkConfig(
+                            nodes=list(self.reconfig_target["nodes"]),
+                            f=self.reconfig_target["f"],
+                            number_of_buckets=self.reconfig_target[
+                                "number_of_buckets"
+                            ],
+                            checkpoint_interval=self.reconfig_target[
+                                "checkpoint_interval"
+                            ],
+                            max_epoch_length=self.reconfig_target[
+                                "max_epoch_length"
+                            ],
+                        )
+                    )
+                ]
+            )
+            self.admin_client_id = (
+                max(self.clients + self.kv_client_ids, default=0) + 1
+            )
+            admin_ids = [self.admin_client_id]
+        self.reconfig_submitted = False
+        self._last_reconfig_submit = 0.0
+        self._adopted_nodes: set = set()  # cached adoption observations
+        self.pending_reconfig_joins: dict = {}  # node -> NodeJoin
         self.supervisor = ClusterSupervisor(
             node_count=scenario.node_count,
-            client_ids=self.clients + self.kv_client_ids,
+            client_ids=self.clients + self.kv_client_ids + admin_ids,
             batch_size=scenario.batch_size,
             processor=processor,
             tick_seconds=tick_seconds,
             proxied=bool(scenario.partitions),
             deferred_nodes=tuple(j.node for j in scenario.joins),
             checkpoint_interval=scenario.notes.get("checkpoint_interval"),
+            network_config=self.reconfig_incumbent,
             app=self.app,
         )
         self.expected = {
@@ -518,10 +678,21 @@ class _MpDriver:
             self.down.discard(payload)
             self.heal_times_ms.append(self.now_ms())
         elif kind == "join":
-            self.supervisor.join_node(payload)
-            self.join_times_ms[payload] = self.now_ms()
-            # Joining is a disruption end: catch-up traffic starts here.
-            self.heal_times_ms.append(self.now_ms())
+            join = next(
+                j for j in self.scenario.joins if j.node == payload
+            )
+            if join.via_reconfig:
+                # Membership authority is the committed op: submit the
+                # grown config now, spawn the node only once an
+                # incumbent has *adopted* it (_service_reconfig).
+                self.pending_reconfig_joins[payload] = join
+                self._submit_reconfig()
+            else:
+                self.supervisor.join_node(payload)
+                self.join_times_ms[payload] = self.now_ms()
+                # Joining is a disruption end: catch-up traffic starts
+                # here.
+                self.heal_times_ms.append(self.now_ms())
         elif kind == "remove":
             self.supervisor.poll_commits()
             self.snapshots.append(
@@ -536,6 +707,14 @@ class _MpDriver:
             # Removal is permanent; the survivors' recovery clock starts
             # at the removal instant.
             self.heal_times_ms.append(self.now_ms())
+            removal = next(
+                r for r in self.scenario.removes if r.node == payload
+            )
+            if removal.via_reconfig:
+                # The survivors now agree the departure is a membership
+                # change: commit the shrunk config through the normal
+                # broadcast path.
+                self._submit_reconfig()
 
     def _observe_catchup(self) -> None:
         """First non-empty app-chain on a joined node = it adopted the
@@ -546,6 +725,164 @@ class _MpDriver:
                 continue
             if self.supervisor.nodes[node].chain:
                 self.catchup_times_ms[node] = self.now_ms()
+
+    # -- dynamic membership --------------------------------------------------
+
+    def _submit_reconfig(self) -> None:
+        """Fire (or re-fire) the admin client's reconfiguration request
+        at every live node.  Resubmission until adoption is deliberate:
+        a partition or leader crash can eat the first copy, and the
+        client-window dedup absorbs the duplicates."""
+        if self.reconfig_payload is None:
+            return
+        request = pb.Request(
+            client_id=self.admin_client_id,
+            req_no=0,
+            data=self.reconfig_payload,
+        )
+        for node_id in self.supervisor.alive_nodes():
+            self.supervisor.submit(node_id, request)
+        self.reconfig_submitted = True
+        self._last_reconfig_submit = time.monotonic()
+
+    def _reconfig_counters(self, node: int) -> dict:
+        doc = read_json(
+            os.path.join(self.supervisor.nodes[node].dir, "reconfig.json")
+        )
+        return doc if isinstance(doc, dict) else {}
+
+    def _incumbent_nodes(self) -> list:
+        """Members booted at cluster start (deferred joiners excluded)
+        that are still supposed to be up."""
+        return [
+            n
+            for n in range(self.scenario.node_count)
+            if n not in self.pending_joins
+            and n not in self.removed
+            and n not in self.down
+        ]
+
+    def _poll_adoptions(self) -> None:
+        for node in self._incumbent_nodes():
+            if node in self._adopted_nodes:
+                continue
+            if int(self._reconfig_counters(node).get("adopted", 0)) >= 1:
+                self._adopted_nodes.add(node)
+
+    def _adoption_complete(self) -> bool:
+        """Every live incumbent has activated the committed config (the
+        convergence gate for via_reconfig scenarios — exiting before
+        adoption would make check_config_agreement vacuous)."""
+        incumbents = self._incumbent_nodes()
+        return bool(incumbents) and all(
+            n in self._adopted_nodes for n in incumbents
+        )
+
+    def _service_reconfig(self) -> None:
+        """Drive the committed-membership-change lifecycle each loop
+        turn: resubmit the admin request until some incumbent adopts,
+        then spawn pending joiners with the exact target config the
+        committed op carried."""
+        if not self.reconfig_submitted:
+            return
+        self._poll_adoptions()
+        if not self._adopted_nodes:
+            if (
+                time.monotonic() - self._last_reconfig_submit
+                > self.retry_period_s
+            ):
+                self._submit_reconfig()
+            return
+        for node in sorted(self.pending_reconfig_joins):
+            self.supervisor.join_node(
+                node, network_config=self.reconfig_target
+            )
+            del self.pending_reconfig_joins[node]
+            self.join_times_ms[node] = self.now_ms()
+            # Joining is a disruption end: catch-up starts here.
+            self.heal_times_ms.append(self.now_ms())
+
+    def _read_checkpoints(self, node: int) -> list:
+        """Every (seq_no, pb.NetworkState) the node certified into its
+        checkpoints.jsonl, torn tail lines tolerated (the process may
+        have been killed mid-write)."""
+        path = os.path.join(
+            self.supervisor.nodes[node].dir, "checkpoints.jsonl"
+        )
+        out = []
+        try:
+            with open(path, encoding="utf-8") as handle:
+                for line in handle:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        record = json.loads(line)
+                        state = pb.decode(
+                            pb.NetworkState,
+                            bytes.fromhex(record["state"]),
+                        )
+                        out.append((int(record["seq"]), state))
+                    except (ValueError, KeyError, TypeError):
+                        continue
+        except OSError:
+            return []
+        return out
+
+    def config_evidence(self, timeout_s: float = 15.0) -> tuple:
+        """The ``check_config_agreement`` inputs, read from the outside:
+        per-node certified checkpoint configs (checkpoints.jsonl), each
+        survivor's newest certified config, and the total adoption count
+        (reconfig.json).  Waits briefly for every survivor's newest
+        checkpoint to carry the target member set — the post-adoption
+        checkpoint trails the adoption boundary by one window, and the
+        heartbeat keeps sequences trickling, so it lands shortly after
+        convergence; a survivor that never gets there surfaces as a
+        final-config divergence, which is exactly the violation."""
+        target = sorted(self.reconfig_target["nodes"])
+        survivors = [
+            n
+            for n in range(self.scenario.node_count)
+            if n not in self.removed
+            and n not in self.down
+            and (n not in self.pending_joins or n in self.join_times_ms)
+        ]
+        deadline = time.monotonic() + timeout_s
+        final_configs: dict = {}
+        while time.monotonic() < deadline:
+            final_configs = {}
+            for node in survivors:
+                entries = self._read_checkpoints(node)
+                if not entries:
+                    continue
+                config = entries[-1][1].config
+                if config is not None and sorted(config.nodes) == target:
+                    final_configs[node] = pb.encode(config)
+            if len(final_configs) == len(survivors):
+                break
+            time.sleep(0.2)
+        # A survivor whose newest certified config never reached the
+        # target set goes in as-is: divergence is the finding.
+        for node in survivors:
+            if node in final_configs:
+                continue
+            entries = self._read_checkpoints(node)
+            if entries and entries[-1][1].config is not None:
+                final_configs[node] = pb.encode(entries[-1][1].config)
+        checkpoint_configs: dict = {}
+        for node in range(self.scenario.node_count):
+            if node in self.pending_joins and node not in self.join_times_ms:
+                continue  # never booted
+            checkpoint_configs[node] = {
+                seq: pb.encode(state.config)
+                for seq, state in self._read_checkpoints(node)
+                if state.config is not None
+            }
+        adoptions = sum(
+            int(self._reconfig_counters(node).get("adopted", 0))
+            for node in survivors
+        )
+        return checkpoint_configs, final_configs, adoptions
 
     def _reap(self) -> None:
         for handle in self.supervisor.nodes:
@@ -608,8 +945,20 @@ class _MpDriver:
                 self.commit_times_ms.append(self.now_ms())
             if self.join_times_ms:
                 self._observe_catchup()
+            if self.reconfig_submitted and (
+                self.pending_reconfig_joins or not self._adoption_complete()
+            ):
+                self._service_reconfig()
             self._reap()
-            if not events and self._converged():
+            if (
+                not events
+                and not self.pending_reconfig_joins
+                and (
+                    self.reconfig_payload is None
+                    or self._adoption_complete()
+                )
+                and self._converged()
+            ):
                 return self.now_ms()
             time.sleep(0.02)
         commits = [len(h.commits) for h in self.supervisor.nodes]
@@ -741,6 +1090,23 @@ def run_mp_scenario(
                         "without installing a snapshot (vacuous join "
                         f"scenario; engine counters: {counters})"
                     )
+            if driver.reconfig_payload is not None:
+                # Dynamic membership audit: adoption actually happened
+                # (vacuity guard), no two nodes ever certified divergent
+                # configs at the same checkpoint, and every survivor
+                # converged to the committed target config.
+                (
+                    checkpoint_configs,
+                    final_configs,
+                    adoptions,
+                ) = driver.config_evidence()
+                agreement = check_config_agreement(
+                    checkpoint_configs, final_configs, adoptions
+                )
+                result.counters["reconfig_adoptions"] = adoptions
+                result.counters["config_checkpoints"] = agreement[
+                    "checkpoints_compared"
+                ]
             if scenario.notes.get("app") == "kv":
                 # The user-visible claim: reads through the KV service
                 # never go backwards or observe forks, even across the

@@ -118,6 +118,7 @@ class ClusterSupervisor:
         keep_root: bool = False,
         deferred_nodes=(),
         checkpoint_interval: int | None = None,
+        network_config: dict | None = None,
         app: str | None = None,
         trace: bool = False,
     ):
@@ -172,6 +173,13 @@ class ClusterSupervisor:
             else None
         )
         self.checkpoint_interval = checkpoint_interval
+        # Explicit genesis NetworkConfig spec dict (nodes/f/buckets/ci/
+        # mel) every fresh incumbent boots under.  For dynamic-membership
+        # runs this is the *pre-reconfig* subset config; the joiner gets
+        # the post-reconfig target via join_node(network_config=...) —
+        # membership authority is the committed Reconfiguration, never a
+        # static spec.
+        self.network_config = dict(network_config) if network_config else None
         self.app = app  # "kv" installs the replicated KV service per node
         # Per-node milestone tracing: each worker dumps <dir>/trace.json
         # (clock_sync-stamped) on graceful shutdown, the input for
@@ -187,7 +195,13 @@ class ClusterSupervisor:
 
     # -- boot ----------------------------------------------------------------
 
-    def _spec(self, node_id: int, fresh: bool, transport_port: int) -> dict:
+    def _spec(
+        self,
+        node_id: int,
+        fresh: bool,
+        transport_port: int,
+        network_config: dict | None = None,
+    ) -> dict:
         latency = {
             str(peer): link
             for peer, link in self.latency.items()
@@ -207,6 +221,9 @@ class ClusterSupervisor:
             "latency": latency,
             "latency_seed": self.latency_seed,
         }
+        explicit = network_config or self.network_config
+        if explicit is not None:
+            spec["network_config"] = dict(explicit)
         if self._boot_leaders is not None:
             # Every fresh worker (including a later joiner) builds the
             # same bootstrap FEntry, so the deterministic initial state
@@ -364,7 +381,12 @@ class ClusterSupervisor:
         with self._lock:
             self._client_transport = client_transport
 
-    def join_node(self, node_id: int, timeout_s: float = 60.0) -> None:
+    def join_node(
+        self,
+        node_id: int,
+        timeout_s: float = 60.0,
+        network_config: dict | None = None,
+    ) -> None:
         """Reconfiguration under fire: spawn a deferred member fresh
         against the running cluster.  The joiner boots the same
         deterministic provisioned state (and bootstrap leader set) as
@@ -381,7 +403,10 @@ class ClusterSupervisor:
         os.makedirs(handle.dir, exist_ok=True)
         write_json_atomic(
             handle.spec_path,
-            self._spec(node_id, fresh=True, transport_port=0),
+            self._spec(
+                node_id, fresh=True, transport_port=0,
+                network_config=network_config,
+            ),
         )
         self._spawn(handle)
         self._wait_address(handle, deadline)

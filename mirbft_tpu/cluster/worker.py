@@ -69,6 +69,7 @@ from ..runtime import (
     build_processor,
 )
 from ..runtime.node import NodeStopped, standard_initial_network_state
+from ..runtime.reconfig import checkpoint_network_state
 from ..runtime.transfer import TransferEngine
 from ..runtime.transport import TcpTransport
 
@@ -161,16 +162,38 @@ class Worker:
             metrics_port=0,
         )
         if spec.get("fresh", True):
-            state = standard_initial_network_state(
-                int(spec["node_count"]), list(spec["client_ids"])
-            )
             # Scenario override (join/catch-up tests shrink the window so
             # a joiner falls a full certified checkpoint behind quickly);
             # identical in every spec, so fresh boots stay deterministic.
             ci = spec.get("checkpoint_interval")
-            if ci:
-                state.config.checkpoint_interval = int(ci)
-                state.config.max_epoch_length = 10 * int(ci)
+            explicit = spec.get("network_config")
+            if explicit:
+                # A reconfiguration boot: the genesis config is dictated
+                # verbatim (for a joiner, the exact target config the
+                # committed Reconfiguration carries; for incumbents, the
+                # pre-reconfig member subset) — membership authority is
+                # the committed op, not the process roster.
+                state = pb.NetworkState(
+                    config=pb.NetworkConfig(
+                        nodes=[int(n) for n in explicit["nodes"]],
+                        f=int(explicit["f"]),
+                        number_of_buckets=int(explicit["number_of_buckets"]),
+                        checkpoint_interval=int(
+                            explicit["checkpoint_interval"]
+                        ),
+                        max_epoch_length=int(explicit["max_epoch_length"]),
+                    ),
+                    clients=[
+                        pb.NetworkClient(id=int(cid), width=100)
+                        for cid in spec["client_ids"]
+                    ],
+                )
+            else:
+                state = standard_initial_network_state(
+                    int(spec["node_count"]),
+                    list(spec["client_ids"]),
+                    checkpoint_interval=int(ci) if ci else None,
+                )
             # A provisioned-but-not-yet-running member set (join-under-
             # fire): boot every worker with the running subset as the
             # bootstrap leaders, so absent members own no buckets until
@@ -339,11 +362,7 @@ class Worker:
             if seq_no in self._announced:
                 continue
             self._announced.add(seq_no)
-            state = pb.NetworkState(
-                config=cr.checkpoint.network_config,
-                clients=cr.checkpoint.clients_state,
-                pending_reconfigurations=list(cr.reconfigurations),
-            )
+            state = checkpoint_network_state(cr)
             self._checkpoint_file.write(
                 json.dumps(
                     {
@@ -404,6 +423,10 @@ class Worker:
             write_json_atomic(
                 os.path.join(self.dir, "transfer.json"), self.engine.status()
             )
+            write_json_atomic(
+                os.path.join(self.dir, "reconfig.json"),
+                self.node.reconfig_status(),
+            )
             if self.app_stream is not None:
                 write_json_atomic(
                     os.path.join(self.dir, "app.json"),
@@ -443,6 +466,13 @@ class Worker:
                     if peers_doc is not None:
                         self._dial_peers(peers_doc)
                     self._publish_transfer_status()
+                if self.node.retired and actions is None:
+                    # An adopted reconfiguration removed this node and the
+                    # action queue has drained: exit gracefully.  The
+                    # survivors already drop our messages at ingress, so
+                    # lingering only wastes their inbound filters.
+                    self.recorder.record_note("worker.retired")
+                    break
         except NodeStopped:
             pass
         except Exception as err:  # noqa: BLE001 — report, then die nonzero

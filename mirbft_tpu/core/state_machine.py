@@ -65,6 +65,11 @@ class StateMachine:
         # at ingress — per-source buffers and quorum maps are keyed by the
         # active config and must never see foreign ids.
         self._members: frozenset = frozenset()
+        # Set when an adopted configuration no longer includes this node:
+        # the embedder should drain and shut the process down cleanly (the
+        # survivors already drop our messages at ingress).
+        self.retired = False
+        self.reconfigs_adopted = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -119,6 +124,8 @@ class StateMachine:
         self._members = frozenset(
             self.commit_state.active_state.config.nodes
         )
+        if self.my_config is not None and self.my_config.id not in self._members:
+            self.retired = True
         self.checkpoint_tracker.reinitialize()
         self.batch_tracker.reinitialize()
         return actions.concat(self.epoch_tracker.reinitialize())
@@ -435,6 +442,11 @@ class StateMachine:
                 # Suspect, so the network rolls into a fresh epoch under
                 # the new configuration.)
                 self.commit_state.reconfigured = False
+                self.reconfigs_adopted += 1
+                if hooks.enabled:
+                    hooks.metrics.counter(
+                        "mirbft_reconfig_adopted_total"
+                    ).inc()
                 actions.concat(self._reinitialize())
 
         for hash_result in results.digests:

@@ -29,6 +29,7 @@ from ..app.stream import CommitStream
 from ..runtime import Config, Node, build_processor
 from ..runtime.node import NodeStopped, standard_initial_network_state
 from ..runtime.processor import Link, Log
+from ..runtime.reconfig import checkpoint_network_state
 from ..runtime.transfer import TransferEngine
 
 
@@ -340,11 +341,7 @@ class _InProcReplica:
 
     def _capture_checkpoints(self, results) -> None:
         for cr in results.checkpoints:
-            network_state = pb.NetworkState(
-                config=cr.checkpoint.network_config,
-                clients=cr.checkpoint.clients_state,
-                pending_reconfigurations=list(cr.reconfigurations),
-            )
+            network_state = checkpoint_network_state(cr)
             self.checkpoints[cr.checkpoint.seq_no] = (cr.value, network_state)
             requests: list = []
             self.reqstore.uncommitted(

@@ -73,6 +73,8 @@ CATALOG = {
     "mirbft_queue_depth": "Items queued in a bounded hot-path queue, by queue name (emitted only through the obsv.bqueue shim; lint rule W19).",
     "mirbft_queue_saturated_total": "Put attempts that found a bounded hot-path queue at capacity (blocked, dropped-oldest, or forced a flush), by queue name.",
     "mirbft_queue_wait_seconds": "Seconds an item spent inside a bounded hot-path queue (enqueue to dequeue), by queue name.",
+    "mirbft_reconfig_committed_total": "Reconfiguration requests committed through the ordered broadcast path, by kind (network_config/new_client/remove_client/unknown).",
+    "mirbft_reconfig_adopted_total": "Reconfiguration activations: stable checkpoints whose pending reconfigurations were adopted (trackers reinitialized into the new NetworkState.config).",
     "mirbft_recorder_overwritten_total": "Flight-recorder ring slots overwritten before ever reaching a dump.",
     "mirbft_recorder_records_total": "Flight-recorder entries recorded, by kind (event/milestone/resource/note).",
     "mirbft_reqstore_appends_total": "Request-store record appends.",
@@ -142,6 +144,8 @@ CATALOG_LABELS = {
     "mirbft_queue_depth": ("queue",),
     "mirbft_queue_saturated_total": ("queue",),
     "mirbft_queue_wait_seconds": ("queue",),
+    "mirbft_reconfig_committed_total": ("kind",),
+    "mirbft_reconfig_adopted_total": (),
     "mirbft_recorder_overwritten_total": (),
     "mirbft_recorder_records_total": ("kind",),
     "mirbft_reqstore_appends_total": (),
@@ -201,6 +205,9 @@ CARDINALITY = {
     "mirbft_queue_wait_seconds": 64,
     # One series per active-epoch bucket (bounded by the leader set).
     "mirbft_bucket_backlog": 256,
+    # Closed kind set (network_config/new_client/remove_client/unknown):
+    # a typo'd kind must fail loudly instead of minting series.
+    "mirbft_reconfig_committed_total": 4,
 }
 
 

@@ -77,17 +77,37 @@ class _EmptyReqStore:
         pass
 
 
-def standard_initial_network_state(node_count: int, client_ids) -> pb.NetworkState:
-    """Default protocol constants (reference: mirbft.go:125-154)."""
-    buckets = node_count
-    ci = 5 * buckets
+def standard_initial_network_state(
+    node_count: int,
+    client_ids,
+    *,
+    nodes=None,
+    checkpoint_interval: int | None = None,
+    max_epoch_length: int | None = None,
+) -> pb.NetworkState:
+    """Default protocol constants (reference: mirbft.go:125-154).
+
+    The keyword overrides exist so embedders can *construct* a
+    non-default genesis (scenario checkpoint intervals, a
+    reconfiguration joiner's target node set) instead of mutating the
+    returned config in place — in-place NetworkConfig mutation outside
+    the adoption seam is banned by lint rule W20."""
+    members = list(nodes) if nodes is not None else list(range(node_count))
+    buckets = len(members)
+    ci = (
+        int(checkpoint_interval)
+        if checkpoint_interval
+        else 5 * buckets
+    )
     return pb.NetworkState(
         config=pb.NetworkConfig(
-            nodes=list(range(node_count)),
-            f=(node_count - 1) // 3,
+            nodes=members,
+            f=(len(members) - 1) // 3,
             number_of_buckets=buckets,
             checkpoint_interval=ci,
-            max_epoch_length=10 * ci,
+            max_epoch_length=(
+                int(max_epoch_length) if max_epoch_length else 10 * ci
+            ),
         ),
         clients=[
             pb.NetworkClient(id=cid, width=100, low_watermark=0)
@@ -312,6 +332,31 @@ class Node:
     @property
     def exit_error(self):
         return self._exit_error
+
+    @property
+    def retired(self) -> bool:
+        """True once an adopted reconfiguration excluded this node from
+        the active member set — the embedder should drain and exit.
+        Plain cross-thread read of a bool the serializer only ever flips
+        False→True; monitoring-grade, no lock needed."""
+        return self._machine.retired
+
+    def reconfig_status(self) -> dict:
+        """Monitoring-grade reconfiguration counters (adopted count,
+        retirement, pending backlog).  Reads serializer-owned state
+        without synchronization: single attribute loads of values the
+        serializer replaces atomically, for status files and dashboards
+        only — never for protocol decisions."""
+        machine = self._machine
+        pending = 0
+        commit_state = machine.commit_state
+        if commit_state is not None and commit_state.active_state is not None:
+            pending = len(commit_state.active_state.pending_reconfigurations)
+        return {
+            "adopted": machine.reconfigs_adopted,
+            "retired": machine.retired,
+            "pending": pending,
+        }
 
     @property
     def metrics_address(self):

@@ -27,6 +27,7 @@ from .. import pb
 from ..core import actions as act
 from ..core.preimage import host_digest
 from ..obsv import hooks
+from .reconfig import decode_reconfig_request, reconfig_kind
 
 
 def _observed_phase(phase):
@@ -86,6 +87,39 @@ class SerialProcessor:
         self.app_log = app_log
         self.wal = wal
         self.request_store = request_store
+        # Reconfiguration requests recognised at store time, keyed by ack,
+        # drained into CheckpointResult.reconfigurations in commit order.
+        # Written by the persist phase, read by the commit phase: in the
+        # pipelined processor those are different stage threads, but
+        # persist(N) always precedes commit(N) and CPython dict ops are
+        # atomic, so no lock is needed.
+        self._reconfig_payloads: dict = {}  # ack key -> [pb.Reconfiguration]
+        self._pending_reconfigs: list = []  # committed, awaiting checkpoint
+        # Restart seeding: StoreRequest actions are not re-emitted on WAL
+        # replay, but committed batches above the durable checkpoint are
+        # re-committed — their reconfigurations must be re-collected, and
+        # the payloads are still in the store (reconfiguration acks are
+        # deliberately never pruned; see _commit).  Only durable stores can
+        # carry pre-boot state, so a store without `uncommitted` (in-memory
+        # harness stubs) has nothing to seed.
+        uncommitted = getattr(self.request_store, "uncommitted", None)
+        if uncommitted is not None:
+            uncommitted(self._seed_reconfig)
+
+    @staticmethod
+    def _ack_key(ack) -> tuple:
+        return (ack.client_id, ack.req_no, bytes(ack.digest))
+
+    def _seed_reconfig(self, ack, data: bytes | None = None) -> None:
+        # FileRequestStore.uncommitted hands only the ack; the in-memory
+        # stores hand (ack, data) — read on demand for the former.
+        if data is None:
+            data = self.request_store.get(ack)
+        if data is None:
+            return
+        reconfigs = decode_reconfig_request(data)
+        if reconfigs:
+            self._reconfig_payloads[self._ack_key(ack)] = reconfigs
 
     # -- phases --------------------------------------------------------------
 
@@ -95,6 +129,7 @@ class SerialProcessor:
         group-commit tickets instead of private fsyncs."""
         for fr in actions.store_requests:
             self.request_store.store(fr.request_ack, fr.request_data)
+            self._seed_reconfig(fr.request_ack, fr.request_data)
         for write in actions.write_ahead:
             if write.truncate is not None:
                 self.wal.truncate(write.truncate)
@@ -155,6 +190,28 @@ class SerialProcessor:
                         commit.batch.seq_no,
                     )
                 for ack in commit.batch.requests:
+                    reconfigs = (
+                        self._reconfig_payloads.get(self._ack_key(ack))
+                        if self._reconfig_payloads
+                        else None
+                    )
+                    if reconfigs is not None:
+                        # Collect in commit order for the window's
+                        # checkpoint.  The ack is deliberately NOT pruned:
+                        # if we crash before the covering CEntry is
+                        # durable, WAL replay re-commits this batch and
+                        # the payload must still be in the store for the
+                        # restart seeding to re-collect (a node that
+                        # pruned would silently drop the reconfiguration
+                        # and fork the config).
+                        self._pending_reconfigs.extend(reconfigs)
+                        if hooks.enabled:
+                            for reconfig in reconfigs:
+                                hooks.metrics.counter(
+                                    "mirbft_reconfig_committed_total",
+                                    kind=reconfig_kind(reconfig),
+                                ).inc()
+                        continue
                     if defer_prune is not None:
                         defer_prune.append(ack)
                     else:
@@ -164,9 +221,15 @@ class SerialProcessor:
                     commit.checkpoint.network_config,
                     commit.checkpoint.clients_state,
                 )
+                reconfigs, self._pending_reconfigs = (
+                    self._pending_reconfigs,
+                    [],
+                )
                 checkpoints.append(
                     act.CheckpointResult(
-                        checkpoint=commit.checkpoint, value=value
+                        checkpoint=commit.checkpoint,
+                        value=value,
+                        reconfigurations=reconfigs,
                     )
                 )
         return checkpoints

@@ -59,6 +59,26 @@ class EpochTargetStatus:
 
 
 @dataclass
+class NetworkConfigStatus:
+    """The active consensus configuration and the reconfiguration
+    pipeline's position: which config this node runs under (epoch it is
+    serving, the checkpoint it was re-anchored at), plus how many
+    committed reconfigurations are pending adoption and how many have
+    been adopted over this process's lifetime."""
+
+    epoch: int
+    first_seq: int  # checkpoint seq_no the active config anchors at
+    nodes: list = field(default_factory=list)
+    f: int = 0
+    number_of_buckets: int = 0
+    checkpoint_interval: int = 0
+    max_epoch_length: int = 0
+    pending_reconfigurations: int = 0
+    reconfigs_adopted: int = 0
+    retired: bool = False
+
+
+@dataclass
 class StateMachineStatus:
     node_id: int
     low_watermark: int
@@ -72,6 +92,7 @@ class StateMachineStatus:
     # large means one leader's bucket is absorbing the hot clients.
     bucket_backlog: list = field(default_factory=list)
     bucket_imbalance: float = 0.0
+    network_config: NetworkConfigStatus | None = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, default=str)
@@ -217,6 +238,23 @@ def state_machine_status(machine) -> StateMachineStatus:
         for cs in machine.client_tracker.client_states
     ]
 
+    config_status = None
+    commit_state = machine.commit_state
+    if commit_state is not None and commit_state.active_state is not None:
+        active = commit_state.active_state
+        config_status = NetworkConfigStatus(
+            epoch=target.number,
+            first_seq=commit_state.low_watermark,
+            nodes=list(active.config.nodes),
+            f=active.config.f,
+            number_of_buckets=active.config.number_of_buckets,
+            checkpoint_interval=active.config.checkpoint_interval,
+            max_epoch_length=active.config.max_epoch_length,
+            pending_reconfigurations=len(active.pending_reconfigurations),
+            reconfigs_adopted=machine.reconfigs_adopted,
+            retired=machine.retired,
+        )
+
     return StateMachineStatus(
         node_id=machine.my_config.id,
         low_watermark=low,
@@ -227,6 +265,7 @@ def state_machine_status(machine) -> StateMachineStatus:
         checkpoints=checkpoints,
         bucket_backlog=backlog,
         bucket_imbalance=imbalance,
+        network_config=config_status,
     )
 
 
@@ -529,6 +568,20 @@ def pretty(status: StateMachineStatus) -> str:
         "===========================================",
         "",
     ]
+    if status.network_config is not None:
+        nc = status.network_config
+        retired = " RETIRED" if nc.retired else ""
+        lines.append("=== Network Config ===")
+        lines.append(
+            f"  epoch {nc.epoch} @seq {nc.first_seq}: nodes={nc.nodes} "
+            f"f={nc.f} buckets={nc.number_of_buckets} "
+            f"ci={nc.checkpoint_interval}{retired}"
+        )
+        lines.append(
+            f"  reconfigs: pending={nc.pending_reconfigurations} "
+            f"adopted={nc.reconfigs_adopted}"
+        )
+        lines.append("")
     if status.buckets:
         lines.append("=== Buckets ===")
         lines.append("  (.=unalloc a=alloc q=pending r=ready "

@@ -290,3 +290,80 @@ def test_duplicate_commit_same_digest_ok_different_raises():
     cs.commit(qentry(1, digest=b"d"))  # idempotent
     with pytest.raises(AssertionError):
         cs.commit(qentry(1, digest=b"other"))
+
+
+def test_reconfigured_checkpoint_certification_first_sight():
+    """Adoption boundary (PR 19): genesis carries a pending reconfiguration
+    (stop shortened to one window); the window's checkpoint result — whose
+    network state has drained the pending list — marks the commit state
+    ``reconfigured`` (the signal for the full tracker reinitialize), extends
+    the stop watermark again, and persists exactly one CEntry.  A recompute
+    of the same seq_no after the reinitialize must not re-trigger."""
+    pending = network_state(
+        reconfigs=[pb.Reconfiguration(type=pb.ReconfigNewClient(id=9, width=5))]
+    )
+    cs, _ = make_commit_state(centry(0, b"genesis", state=pending))
+    assert cs.stop_at_seq_no == 5  # allocation halted one window out
+    for s in range(1, 6):
+        cs.commit(qentry(s))
+    cs.drain()
+    result = pb.CheckpointResult(
+        seq_no=5, value=b"cp5", network_state=network_state()
+    )
+    actions = cs.apply_checkpoint_result(None, result)
+    assert cs.reconfigured, "adoption checkpoint did not mark reconfigured"
+    assert cs.stop_at_seq_no == 15  # pending drained -> full two windows
+    c_entries = [
+        w for w in actions.write_ahead
+        if isinstance(w.append.data.type, pb.CEntry)
+    ]
+    assert len(c_entries) == 1, "adoption must persist exactly one CEntry"
+
+
+def test_reconfigured_checkpoint_not_reactivated_when_already_persisted():
+    """First-sight guard: when the adoption checkpoint's CEntry is already
+    durable (a recompute after the reconfiguration reinitialize), applying
+    the result again must neither re-trigger activation nor duplicate the
+    CEntry — only the Checkpoint broadcast goes out."""
+    pending = network_state(
+        reconfigs=[pb.Reconfiguration(type=pb.ReconfigNewClient(id=9, width=5))]
+    )
+    cs, _ = make_commit_state(centry(0, b"genesis", state=pending))
+    for s in range(1, 6):
+        cs.commit(qentry(s))
+    cs.drain()
+    cs.highest_persisted_checkpoint = 5  # the CEntry is already in the log
+    actions = cs.apply_checkpoint_result(
+        None,
+        pb.CheckpointResult(seq_no=5, value=b"cp5", network_state=network_state()),
+    )
+    assert not cs.reconfigured, "recompute must not re-trigger activation"
+    assert not any(
+        isinstance(w.append.data.type, pb.CEntry) for w in actions.write_ahead
+    )
+    [send] = actions.sends
+    assert send.msg == pb.Msg(type=pb.Checkpoint(seq_no=5, value=b"cp5"))
+
+
+def test_checkpoint_result_with_pending_reconfig_does_not_extend_stop():
+    """A checkpoint result that still carries pending reconfigurations
+    leaves the stop watermark where it was: ordering may finish the current
+    window but must not be granted the next one until adoption."""
+    cs, _ = make_commit_state(centry(0, b"genesis"))
+    assert cs.stop_at_seq_no == 10
+    for s in range(1, 6):
+        cs.commit(qentry(s))
+    cs.drain()
+    still_pending = network_state(
+        reconfigs=[pb.Reconfiguration(type=pb.ReconfigNewClient(id=9, width=5))]
+    )
+    cs.apply_checkpoint_result(
+        None,
+        pb.CheckpointResult(seq_no=5, value=b"cp5", network_state=still_pending),
+    )
+    assert cs.stop_at_seq_no == 10, "stop must not extend while pending"
+    assert not cs.reconfigured  # the *previous* state had nothing pending
+    for s in range(6, 11):
+        cs.commit(qentry(s))  # finishing the granted window is fine
+    with pytest.raises(AssertionError):
+        cs.commit(qentry(11))  # but not one batch more

@@ -1161,3 +1161,48 @@ def test_w19_queue_series_confined_to_bqueue_shim(tmp_path):
     harness.parent.mkdir(parents=True)
     harness.write_text("SERIES = 'mirbft_queue_depth'\n")
     assert not any("W19" in line for line in lint.check_file(harness))
+
+
+def test_w20_config_mutation_confined_to_adoption_seam(tmp_path):
+    """W20: in-place writes through NetworkConfig/NetworkState objects
+    are confined to core/commitstate.py + core/actions.py (the
+    checkpoint-boundary adoption seam); every other layer must build a
+    fresh object, so the committed Reconfiguration stays the single
+    membership authority."""
+    import lint
+
+    sneaky = tmp_path / "mirbft_tpu" / "runtime" / "sneaky_cfg.py"
+    sneaky.parent.mkdir(parents=True)
+    sneaky.write_text(
+        "def shrink(state, ci):\n"
+        "    state.config.checkpoint_interval = ci\n"
+        "    state.network_config.nodes[0] = 9\n"
+        "    machine.active_state.reconfigured = True\n"
+    )
+    findings = [line for line in lint.check_file(sneaky) if "W20" in line]
+    assert len(findings) == 3, findings
+
+    # Rebinding a plain attribute to a *fresh* object is the sanctioned
+    # way to change configuration outside the seam.
+    fine = tmp_path / "mirbft_tpu" / "runtime" / "fine_cfg.py"
+    fine.write_text(
+        "def adopt(self, fresh):\n"
+        "    self.network_state = fresh\n"
+        "    config = fresh.config\n"
+    )
+    assert not any("W20" in line for line in lint.check_file(fine))
+
+    # The adoption seam itself, checked against the real sources.
+    for allowed in ("commitstate.py", "actions.py"):
+        assert not any(
+            "W20" in line
+            for line in lint.check_file(
+                REPO / "mirbft_tpu" / "core" / allowed
+            )
+        ), allowed
+
+    # Outside the package tree (tests, tools, bench) the rule is off.
+    harness = tmp_path / "tests" / "test_cfg.py"
+    harness.parent.mkdir(parents=True)
+    harness.write_text("state.config.f = 0\n")
+    assert not any("W20" in line for line in lint.check_file(harness))

@@ -1,0 +1,246 @@
+"""Unit gates for the dynamic-membership runtime seams (PR 19): the
+reconfiguration request codec, the shared checkpoint-result network-state
+helper, the config-agreement invariant, the status surface, and the metric
+catalog rows.  The protocol-level behavior is covered end to end in
+test_reconfiguration.py; these pin the seams the drivers and workers share."""
+
+import json
+
+import pytest
+
+from mirbft_tpu import pb
+from mirbft_tpu.chaos.invariants import InvariantViolation, check_config_agreement
+from mirbft_tpu.core import actions as act
+from mirbft_tpu.obsv import metrics as metrics_mod
+from mirbft_tpu.runtime.reconfig import (
+    RECONFIG_MAGIC,
+    checkpoint_network_state,
+    decode_reconfig_request,
+    encode_reconfig_request,
+    is_reconfig_request,
+    reconfig_kind,
+)
+from mirbft_tpu.status import state_machine_status
+from mirbft_tpu.testengine import BasicRecorder
+
+
+# ---------------------------------------------------------------------------
+# Request codec
+# ---------------------------------------------------------------------------
+
+
+def _sample_reconfigs():
+    return [
+        pb.Reconfiguration(type=pb.ReconfigNewClient(id=7, width=50)),
+        pb.Reconfiguration(type=pb.ReconfigRemoveClient(client_id=3)),
+        pb.Reconfiguration(
+            type=pb.NetworkConfig(
+                nodes=[0, 1, 2, 3, 4],
+                f=1,
+                number_of_buckets=4,
+                checkpoint_interval=8,
+                max_epoch_length=16,
+            )
+        ),
+    ]
+
+
+def test_reconfig_request_round_trip():
+    payload = encode_reconfig_request(_sample_reconfigs())
+    assert is_reconfig_request(payload)
+    decoded = decode_reconfig_request(payload)
+    assert [pb.encode(r) for r in decoded] == [
+        pb.encode(r) for r in _sample_reconfigs()
+    ]
+
+
+def test_reconfig_request_empty_list_is_still_marked():
+    payload = encode_reconfig_request([])
+    assert payload == RECONFIG_MAGIC
+    assert decode_reconfig_request(payload) == []
+
+
+def test_non_reconfig_payload_decodes_to_none():
+    # Ordinary app payloads — including ones that merely *contain* the
+    # magic somewhere inside — are not reconfiguration requests.
+    assert decode_reconfig_request(b"set k v") is None
+    assert decode_reconfig_request(b"x" + RECONFIG_MAGIC) is None
+    assert not is_reconfig_request(b"")
+
+
+def test_malformed_reconfig_payload_is_same_everywhere_noop():
+    """A payload carrying the magic but truncated mid-entry must decode to
+    [] (not raise, not None): the request committed in the same order at
+    every correct node, so all must draw the identical conclusion."""
+    good = encode_reconfig_request(_sample_reconfigs())
+    for cut in (len(RECONFIG_MAGIC) + 2, len(good) - 3):
+        assert decode_reconfig_request(good[:cut]) == []
+    # Length prefix pointing past the buffer.
+    assert decode_reconfig_request(RECONFIG_MAGIC + b"\xff\xff\xff\xff") == []
+
+
+def test_reconfig_kind_arms():
+    new_client, remove_client, network = _sample_reconfigs()
+    assert reconfig_kind(new_client) == "new_client"
+    assert reconfig_kind(remove_client) == "remove_client"
+    assert reconfig_kind(network) == "network_config"
+    assert reconfig_kind(pb.Reconfiguration(type=None)) == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Shared checkpoint-result -> NetworkState helper
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_network_state_threads_pending_reconfigs():
+    config = pb.NetworkConfig(
+        nodes=[0, 1, 2, 3], f=1, number_of_buckets=4,
+        checkpoint_interval=5, max_epoch_length=50,
+    )
+    clients = [pb.NetworkClient(id=9, width=10, low_watermark=2)]
+    cr = act.CheckpointResult(
+        checkpoint=act.CheckpointReq(
+            seq_no=15, network_config=config, clients_state=clients
+        ),
+        value=b"cp",
+        reconfigurations=_sample_reconfigs(),
+    )
+    state = checkpoint_network_state(cr)
+    assert state.config == config
+    assert state.clients == clients
+    assert [pb.encode(r) for r in state.pending_reconfigurations] == [
+        pb.encode(r) for r in _sample_reconfigs()
+    ]
+    # No reconfigurations in the window -> an empty pending list, never None.
+    bare = act.CheckpointResult(
+        checkpoint=cr.checkpoint, value=b"cp", reconfigurations=[]
+    )
+    assert checkpoint_network_state(bare).pending_reconfigurations == []
+
+
+# ---------------------------------------------------------------------------
+# Config-agreement invariant
+# ---------------------------------------------------------------------------
+
+
+_CFG_A = pb.encode(
+    pb.NetworkConfig(nodes=[0, 1, 2, 3], f=1, number_of_buckets=4,
+                     checkpoint_interval=5, max_epoch_length=50)
+)
+_CFG_B = pb.encode(
+    pb.NetworkConfig(nodes=[0, 1, 2, 3, 4], f=1, number_of_buckets=4,
+                     checkpoint_interval=5, max_epoch_length=50)
+)
+
+
+def test_config_agreement_vacuity_guard():
+    with pytest.raises(InvariantViolation, match="vacuous"):
+        check_config_agreement(
+            {0: {5: _CFG_A}}, {0: _CFG_A}, adoptions=0
+        )
+
+
+def test_config_agreement_detects_checkpoint_fork():
+    checkpoint_configs = {
+        0: {5: _CFG_A, 10: _CFG_B},
+        1: {5: _CFG_A, 10: _CFG_A},  # node 1 certified a different config at 10
+    }
+    with pytest.raises(InvariantViolation):
+        check_config_agreement(
+            checkpoint_configs, {0: _CFG_B, 1: _CFG_B}, adoptions=2
+        )
+
+
+def test_config_agreement_detects_final_divergence():
+    checkpoint_configs = {0: {5: _CFG_A}, 1: {5: _CFG_A}}
+    with pytest.raises(InvariantViolation):
+        check_config_agreement(
+            checkpoint_configs, {0: _CFG_A, 1: _CFG_B}, adoptions=1
+        )
+
+
+def test_config_agreement_happy_path_tallies():
+    checkpoint_configs = {
+        0: {5: _CFG_A, 10: _CFG_B},
+        1: {10: _CFG_B},  # sparse evidence (e.g. a late joiner) is fine
+    }
+    tally = check_config_agreement(
+        checkpoint_configs, {0: _CFG_B, 1: _CFG_B}, adoptions=2
+    )
+    assert tally["adoptions"] == 2
+    # Only cross-node re-sightings count as comparisons: seq 5 has a single
+    # witness, seq 10 two -> one genuine byte-equality check performed.
+    assert tally["checkpoints_compared"] == 1
+    assert tally["survivors"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Status surface + metric catalog
+# ---------------------------------------------------------------------------
+
+
+def test_status_exposes_network_config_section():
+    rec = BasicRecorder(node_count=4, client_count=1, reqs_per_client=8)
+    rec.drain_clients(max_steps=500_000)
+    status = state_machine_status(rec.machines[0])
+    section = status.network_config
+    assert section is not None
+    assert section.nodes == [0, 1, 2, 3]
+    assert section.f == 1
+    assert section.pending_reconfigurations == 0
+    assert section.reconfigs_adopted == 0
+    assert section.retired is False
+    blob = json.loads(status.to_json())
+    assert blob["network_config"]["nodes"] == [0, 1, 2, 3]
+    assert "reconfigs_adopted" in blob["network_config"]
+    assert "nodes=[0, 1, 2, 3]" in status.pretty() or "nodes" in status.pretty()
+
+
+def test_removed_node_retires_and_counts_adoption():
+    """After a node-set shrink activates, the excluded node's machine is
+    ``retired`` and every member's status counts the adoption."""
+    state = pb.NetworkState(
+        config=pb.NetworkConfig(
+            nodes=[0, 1, 2, 3, 4], f=1, number_of_buckets=4,
+            checkpoint_interval=8, max_epoch_length=16,
+        ),
+        clients=[
+            pb.NetworkClient(id=cid, width=48, low_watermark=0)
+            for cid in (10, 11)
+        ],
+    )
+    four_node = pb.NetworkConfig(
+        nodes=[0, 1, 2, 3], f=1, number_of_buckets=4,
+        checkpoint_interval=8, max_epoch_length=16,
+    )
+    rec = BasicRecorder(
+        node_count=5, client_count=2, reqs_per_client=40, batch_size=2,
+        network_state=state,
+    )
+    rec.reconfig_on_commit[(11, 2)] = [pb.Reconfiguration(type=four_node)]
+    rec.drain_until(lambda r: r.machines[4].retired, max_steps=1_000_000)
+    retired_status = state_machine_status(rec.machines[4])
+    assert retired_status.network_config.retired is True
+    rec.crash(4)
+    rec.drain_clients(max_steps=2_000_000)
+    for n in range(4):
+        section = state_machine_status(rec.machines[n]).network_config
+        assert section.nodes == [0, 1, 2, 3]
+        assert section.reconfigs_adopted >= 1
+        assert section.retired is False
+
+
+def test_reconfig_metrics_cataloged_and_budgeted():
+    for name in (
+        "mirbft_reconfig_committed_total",
+        "mirbft_reconfig_adopted_total",
+    ):
+        assert name in metrics_mod.CATALOG
+        assert name in metrics_mod.CATALOG_LABELS
+    assert metrics_mod.CATALOG_LABELS["mirbft_reconfig_committed_total"] == (
+        "kind",
+    )
+    assert metrics_mod.CATALOG_LABELS["mirbft_reconfig_adopted_total"] == ()
+    # The kind label is a closed four-arm set; the budget must match so a
+    # typo'd kind is rejected rather than silently growing a series.
+    assert metrics_mod.CARDINALITY["mirbft_reconfig_committed_total"] == 4

@@ -317,3 +317,232 @@ def test_node_set_reconfiguration_grow_with_crash_at_boundary():
     total = 2 * 40
     for n in range(5):
         assert rec.committed_at(n) == total
+
+
+# -- seed-pinned determinism matrix ------------------------------------------
+#
+# Every reconfiguration shape the protocol supports, replayed twice per seed
+# with an event interceptor hashing the full (node, time, event) stream: the
+# two logs must be byte-identical.  Reconfiguration rides the deterministic
+# simulation like any other commit — if adoption ever consulted wall-clock,
+# iteration order, or anything else outside the event stream, these pins
+# would catch it as a one-byte divergence.
+
+import pytest
+
+
+def _drive_add_client(seed, interceptor):
+    rec = BasicRecorder(
+        node_count=4, client_count=1, reqs_per_client=30,
+        seed=seed, interceptor=interceptor,
+    )
+    rec.reconfig_on_commit[(4, 10)] = _new_client_reconfig()
+    rec.drain_clients(max_steps=1_000_000)
+    rec.drain_until(
+        lambda r: all(
+            r.machines[n].client_tracker.client(NEW) is not None
+            for n in range(4)
+        ),
+        max_steps=1_000_000,
+    )
+    rec.add_client(NEW, 3)
+    rec.drain_clients(max_steps=1_000_000)
+    assert len({rec.node_states[n].app_chain for n in range(4)}) == 1
+    return rec
+
+
+def _drive_add_node(seed, interceptor):
+    rec = BasicRecorder(
+        node_count=5, client_count=2, reqs_per_client=40, batch_size=2,
+        network_state=_grow_state(), deferred_nodes=(4,),
+        seed=seed, interceptor=interceptor,
+    )
+    rec.reconfig_on_commit[(10, 2)] = [pb.Reconfiguration(type=_FIVE_NODE_CONFIG)]
+    rec.drain_until(lambda r: 4 in _active_nodes(r, 0), max_steps=500_000)
+    seq = _reconfig_checkpoint(rec, 0, want_member=True)
+    assert seq is not None
+    rec.provision_node(4, from_node=0, seq_no=seq, delay=50)
+    rec.drain_clients(max_steps=2_000_000)
+    assert len({rec.node_states[n].app_chain for n in range(5)}) == 1
+    return rec
+
+
+def _drive_remove_node(seed, interceptor):
+    state = pb.NetworkState(
+        config=_FIVE_NODE_CONFIG,
+        clients=[
+            pb.NetworkClient(id=cid, width=48, low_watermark=0)
+            for cid in (10, 11)
+        ],
+    )
+    four_node = pb.NetworkConfig(
+        nodes=[0, 1, 2, 3], f=1, number_of_buckets=4,
+        checkpoint_interval=8, max_epoch_length=16,
+    )
+    rec = BasicRecorder(
+        node_count=5, client_count=2, reqs_per_client=40, batch_size=2,
+        network_state=state, seed=seed, interceptor=interceptor,
+    )
+    rec.reconfig_on_commit[(11, 2)] = [pb.Reconfiguration(type=four_node)]
+    rec.drain_until(
+        lambda r: _active_nodes(r, 0) and 4 not in _active_nodes(r, 0),
+        max_steps=500_000,
+    )
+    rec.crash(4)
+    rec.drain_clients(max_steps=2_000_000)
+    assert len({rec.node_states[n].app_chain for n in range(4)}) == 1
+    return rec
+
+
+def _drive_shrink_then_grow(seed, interceptor):
+    """Shrink 5 -> 4, then grow back 4 -> 5 and re-provision the node
+    that was removed: the second reconfiguration is registered only once
+    the first has activated (a deterministic point in the event stream),
+    so the two node-set changes ride distinct checkpoint windows."""
+    state = pb.NetworkState(
+        config=_FIVE_NODE_CONFIG,
+        clients=[
+            pb.NetworkClient(id=cid, width=160, low_watermark=0)
+            for cid in (10, 11)
+        ],
+    )
+    four_node = pb.NetworkConfig(
+        nodes=[0, 1, 2, 3], f=1, number_of_buckets=4,
+        checkpoint_interval=8, max_epoch_length=16,
+    )
+    rec = BasicRecorder(
+        node_count=5, client_count=2, reqs_per_client=120, batch_size=2,
+        network_state=state, seed=seed, interceptor=interceptor,
+    )
+    rec.reconfig_on_commit[(11, 2)] = [pb.Reconfiguration(type=four_node)]
+    rec.drain_until(
+        lambda r: _active_nodes(r, 0) and 4 not in _active_nodes(r, 0),
+        max_steps=500_000,
+    )
+    rec.crash(4)
+    # Grow back: registered post-activation, keyed to the first request no
+    # node has applied yet (ordering runs ahead of activation by up to a
+    # stop-watermark's worth of batches, so a fixed req_no could already
+    # be applied at some nodes but not others — a forked trigger).
+    peak = max(
+        (max(s) for s in rec.clients[10].committed_by_node.values() if s),
+        default=-1,
+    )
+    trigger = peak + 1
+    assert trigger < 120, f"workload exhausted before re-grow ({trigger})"
+    rec.reconfig_on_commit[(10, trigger)] = [
+        pb.Reconfiguration(type=_FIVE_NODE_CONFIG)
+    ]
+    rec.drain_until(lambda r: 4 in _active_nodes(r, 0), max_steps=2_000_000)
+    seq = _reconfig_checkpoint(rec, 0, want_member=True)
+    assert seq is not None
+    rec.provision_node(4, from_node=0, seq_no=seq, delay=50)
+    rec.drain_clients(max_steps=2_000_000)
+    assert len({rec.node_states[n].app_chain for n in range(5)}) == 1
+    return rec
+
+
+def _drive_reconfig_with_epoch_change(seed, interceptor):
+    """A reconfiguration committing in the same window as a crash-induced
+    epoch change: adoption and the epoch roll must serialize identically
+    on every run."""
+    rec = BasicRecorder(
+        node_count=4, client_count=1, reqs_per_client=30,
+        seed=seed, interceptor=interceptor,
+    )
+    rec.reconfig_on_commit[(4, 8)] = _new_client_reconfig()
+    rec.drain_until(lambda r: r.committed_at(0) >= 8, max_steps=1_000_000)
+    rec.crash(2)
+    rec.schedule_restart(2, 5_000)
+    rec.drain_clients(max_steps=1_000_000)
+    rec.drain_until(
+        lambda r: all(
+            r.machines[n].client_tracker.client(NEW) is not None
+            for n in range(4)
+        ),
+        max_steps=1_000_000,
+    )
+    epochs = {rec.machines[n].epoch_tracker.current_epoch.number for n in range(4)}
+    assert all(e >= 1 for e in epochs), epochs
+    assert len({rec.node_states[n].app_chain for n in range(4)}) == 1
+    return rec
+
+
+_MATRIX = {
+    "add-client": _drive_add_client,
+    "add-node": _drive_add_node,
+    "remove-node": _drive_remove_node,
+    "shrink-then-grow": _drive_shrink_then_grow,
+    "reconfig-with-epoch-change": _drive_reconfig_with_epoch_change,
+}
+
+
+def _run_logged(drive, seed):
+    log = []
+
+    def interceptor(node, now, event):
+        log.append(b"%d|%d|" % (node, now) + pb.encode(event))
+
+    drive(seed, interceptor)
+    return b"\x00".join(log)
+
+
+@pytest.mark.parametrize("case", sorted(_MATRIX))
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_reconfig_matrix_seed_pinned_byte_identical(case, seed):
+    drive = _MATRIX[case]
+    first = _run_logged(drive, seed)
+    second = _run_logged(drive, seed)
+    assert first, f"{case} seed {seed} produced an empty event log"
+    assert first == second, (
+        f"{case} seed {seed}: two runs diverged "
+        f"({len(first)} vs {len(second)} log bytes)"
+    )
+
+
+def test_stop_watermark_halts_allocation_while_reconfig_pending():
+    """Invariant, checked at every event of a full grow run: while a
+    reconfiguration is pending adoption the stop watermark shortens to one
+    checkpoint interval above the low watermark (commitstate.reinitialize /
+    apply_checkpoint_result), and commits never outrun it."""
+    holder = {}
+    pending_seen = [0]
+
+    def interceptor(node, now, event):
+        rec = holder.get("rec")
+        if rec is None:
+            return
+        machine = rec.machines.get(node)
+        if machine is None or machine.commit_state is None:
+            return
+        cs = machine.commit_state
+        if cs.active_state is None:
+            return
+        ci = cs.active_state.config.checkpoint_interval
+        assert cs.highest_commit <= cs.stop_at_seq_no, (
+            f"node {node} committed {cs.highest_commit} past stop "
+            f"{cs.stop_at_seq_no}"
+        )
+        assert cs.stop_at_seq_no <= cs.low_watermark + 2 * ci
+        if cs.active_state.pending_reconfigurations:
+            pending_seen[0] += 1
+            assert cs.stop_at_seq_no <= cs.low_watermark + ci, (
+                f"node {node}: pending reconfig but stop "
+                f"{cs.stop_at_seq_no} > low {cs.low_watermark} + ci {ci}"
+            )
+
+    rec = BasicRecorder(
+        node_count=5, client_count=2, reqs_per_client=40, batch_size=2,
+        network_state=_grow_state(), deferred_nodes=(4,),
+        interceptor=interceptor,
+    )
+    holder["rec"] = rec
+    rec.reconfig_on_commit[(10, 2)] = [pb.Reconfiguration(type=_FIVE_NODE_CONFIG)]
+    rec.drain_until(lambda r: 4 in _active_nodes(r, 0), max_steps=500_000)
+    seq = _reconfig_checkpoint(rec, 0, want_member=True)
+    assert seq is not None
+    rec.provision_node(4, from_node=0, seq_no=seq, delay=50)
+    rec.drain_clients(max_steps=2_000_000)
+    # Vacuity guard: the invariant must actually have been exercised in
+    # the pending-window state, not merely in steady state.
+    assert pending_seen[0] > 0, "no event ever observed a pending reconfig"

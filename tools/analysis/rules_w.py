@@ -1,4 +1,4 @@
-"""General defect classes W1..W19 (the original tools/lint.py checks as
+"""General defect classes W1..W20 (the original tools/lint.py checks as
 Rule objects, message-compatible, plus the seeded-randomness ban and the
 adversary-tooling, resource-introspection, device-timing, and
 snapshot-I/O confinements).
@@ -65,6 +65,13 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   depth/wait/saturation semantics; an ad-hoc gauge would fork the
   meaning of "queue depth" per call site and silently bypass the
   saturation accounting the capacity rung attributes against.
+- W20 in-place writes through ``NetworkConfig``/``NetworkState``
+  objects outside ``core/commitstate.py`` + ``core/actions.py`` — the
+  checkpoint-boundary adoption seam is the only place allowed to mutate
+  active configuration.  Every other layer builds a fresh object, so a
+  committed ``Reconfiguration`` stays the single membership authority;
+  a stray ``x.config.field = v`` in an embedder is exactly how two
+  nodes end up running divergent configs at the same sequence number.
 """
 
 from __future__ import annotations
@@ -335,6 +342,31 @@ def in_queue_series_ban_scope(posix: str) -> bool:
 def in_core_jax_ban_scope(posix: str) -> bool:
     """True for mirbft_tpu/core/ files where W16 bans jax imports."""
     return "mirbft_tpu/core/" in posix and CORE_JAX_ALLOWED_FILE not in posix
+
+
+# The adoption seam: the only files allowed to mutate the innards of a
+# NetworkConfig/NetworkState in place.  commitstate.py owns config
+# activation (next_network_config / the reconfigured-checkpoint flip)
+# and actions.py owns CheckpointResult construction.  Everyone else must
+# build a fresh pb.NetworkConfig/pb.NetworkState — a stray in-place edit
+# outside the seam is exactly how two nodes end up running divergent
+# configs at the same sequence number.
+CONFIG_MUTATION_ALLOWED_FILES = (
+    "mirbft_tpu/core/commitstate.py",
+    "mirbft_tpu/core/actions.py",
+)
+
+# Attribute bases whose fields must not be assigned outside the seam.
+CONFIG_MUTATION_BASES = frozenset(
+    {"config", "network_config", "network_state", "active_state"}
+)
+
+
+def in_config_mutation_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W20 confines config mutation."""
+    return "mirbft_tpu/" in posix and not any(
+        posix.endswith(allowed) for allowed in CONFIG_MUTATION_ALLOWED_FILES
+    )
 
 
 def _spawn_helper_spans(tree: ast.Module) -> list[tuple[int, int]]:
@@ -866,6 +898,51 @@ def _check_w19(ctx: FileContext):
             yield Finding("W19", ctx.path, node.lineno, msg)
 
 
+def _config_mutation_hit(target) -> bool:
+    """True when an assignment target writes *through* a config/state
+    object — ``x.config.checkpoint_interval = v``,
+    ``self.active_state.reconfigured = True``,
+    ``state.network_config.nodes[i] = v`` — as opposed to rebinding a
+    plain attribute (``self.network_state = fresh`` stays legal: handing
+    out a new object is how everyone *outside* the seam is supposed to
+    change configuration)."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return any(_config_mutation_hit(elt) for elt in target.elts)
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if not isinstance(target, ast.Attribute):
+        return False
+    value = target.value
+    while True:
+        if isinstance(value, ast.Attribute):
+            if value.attr in CONFIG_MUTATION_BASES:
+                return True
+            value = value.value
+        elif isinstance(value, ast.Name):
+            return value.id in CONFIG_MUTATION_BASES
+        else:
+            return False
+
+
+def _check_w20(ctx: FileContext):
+    msg = (
+        "NetworkConfig/NetworkState mutated outside the adoption seam "
+        "(core/commitstate.py + core/actions.py own in-place config "
+        "changes; everywhere else must construct a fresh "
+        "pb.NetworkConfig/pb.NetworkState — an in-place edit here can "
+        "diverge the active config across nodes)"
+    )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        else:
+            continue
+        if any(_config_mutation_hit(target) for target in targets):
+            yield Finding("W20", ctx.path, node.lineno, msg)
+
+
 def _as_list(gen_fn):
     def check(ctx):
         return list(gen_fn(ctx))
@@ -1084,6 +1161,24 @@ register(
         ),
         check=_as_list(_check_w19),
         scope=in_queue_series_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W20",
+        title="config mutation outside the adoption seam",
+        doc=(
+            "In-place writes through NetworkConfig/NetworkState objects "
+            "(x.config.field = v, self.active_state.reconfigured = True) "
+            "are confined to core/commitstate.py and core/actions.py — "
+            "the checkpoint-boundary adoption seam.  Every other layer "
+            "changes configuration by constructing a fresh object, so a "
+            "committed Reconfiguration stays the single membership "
+            "authority and no embedder can locally fork the active "
+            "config."
+        ),
+        check=_as_list(_check_w20),
+        scope=in_config_mutation_ban_scope,
     )
 )
 register(
