@@ -345,6 +345,109 @@ def check_transfer_corruption_rejected(
         )
 
 
+def check_mac_rejected(
+    rejections: int, forged: int, exact: bool = True
+) -> None:
+    """MAC-authenticated replica channels reject 100% of forged/tampered
+    node-to-node traffic.  ``forged`` is the adversary's touch count
+    (zero = vacuous); ``rejections`` the MAC layer's evidence — the
+    deterministic MacSealPlane's counter or the live transports'
+    ``mac_rejections`` sum.
+
+    The deterministic engine delivers every forged message exactly once,
+    so the audit demands exact equality (``exact=True``: no more —
+    honest traffic passes — and no fewer — nothing slips through).  On
+    the live transport a forged frame can die with its TCP connection
+    before reaching the receiver (reconnects, shutdown races), so the
+    live audit demands rejection evidence exists and never exceeds the
+    forgery count; the none-was-*accepted* half is held by the no-fork /
+    convergence audits, which a single admitted forgery would break."""
+    if forged <= 0:
+        raise InvariantViolation(
+            "MAC-forgery scenario touched no replica frames (vacuous)"
+        )
+    if exact and rejections != forged:
+        raise InvariantViolation(
+            f"MAC layer rejected {rejections} of {forged} forged replica "
+            "messages"
+        )
+    if not exact:
+        if rejections <= 0:
+            raise InvariantViolation(
+                f"{forged} forged replica frames produced no MAC "
+                "rejection evidence (mac_rejections == 0)"
+            )
+        if rejections > forged:
+            raise InvariantViolation(
+                f"MAC layer rejected {rejections} frames but the "
+                f"adversary only forged {forged} — honest traffic was "
+                "refused"
+            )
+
+
+def check_aggregate_cert_rejected(
+    genuine_ok: int,
+    genuine_total: int,
+    forged_rejected: int,
+    forged_total: int,
+) -> None:
+    """Aggregate quorum certificates are sound both ways: every genuine
+    certificate the cluster produced verifies under one aggregate check,
+    and every forged variant (mismatched statement, wrong signer set) is
+    rejected — 100%, with vacuity guards on both sides."""
+    if genuine_total <= 0:
+        raise InvariantViolation(
+            "certificate audit saw no quorum certificates (vacuous — the "
+            "run never reached a stable checkpoint)"
+        )
+    if genuine_ok != genuine_total:
+        raise InvariantViolation(
+            f"only {genuine_ok} of {genuine_total} genuine aggregate "
+            "certificates verified"
+        )
+    if forged_total <= 0:
+        raise InvariantViolation(
+            "certificate audit built no forged variants (vacuous)"
+        )
+    if forged_rejected != forged_total:
+        raise InvariantViolation(
+            f"only {forged_rejected} of {forged_total} forged aggregate "
+            "certificates were rejected"
+        )
+
+
+def audit_aggregate_certs(certs: dict) -> tuple:
+    """Exercise the qc seam over a run's quorum certificates:
+    ``certs`` maps (seq_no, value) -> (signer ids, aggregate signature)
+    (CheckpointCertPlane.certificates(), or the live synthesis).  Every
+    genuine certificate must verify; per certificate two forgeries are
+    attempted — a mismatched statement (wrong seq_no under a valid
+    aggregate) and a wrong signer set (aggregate public key excludes a
+    voter) — and must fail.  Returns
+    ``(genuine_ok, genuine_total, forged_rejected, forged_total)`` for
+    :func:`check_aggregate_cert_rejected`."""
+    from ..testengine.certs import CheckpointCertPlane, node_seed, statement
+    from ..crypto import qc
+
+    genuine_ok = forged_rejected = forged_total = 0
+    for (seq_no, value), (signers, asig) in certs.items():
+        if CheckpointCertPlane.verify(seq_no, value, signers, asig):
+            genuine_ok += 1
+        # Forgery 1: valid aggregate, mismatched statement.
+        forged_total += 1
+        if not CheckpointCertPlane.verify(seq_no + 1, value, signers, asig):
+            forged_rejected += 1
+        # Forgery 2: wrong signer set — the aggregate public key drops
+        # one voter and claims a non-voter instead.
+        forged_total += 1
+        imposter = max(signers) + 1
+        wrong = list(signers[1:]) + [imposter]
+        pks = [qc.public_key(node_seed(n)) for n in wrong]
+        if not qc.verify_cert(pks, statement(seq_no, value), asig):
+            forged_rejected += 1
+    return genuine_ok, len(certs), forged_rejected, forged_total
+
+
 def check_bounded_recovery(
     completion_ms: int, last_disruption_end_ms: int, bound_ms: int
 ) -> None:

@@ -73,15 +73,19 @@ from ..runtime.transport import (
     TcpTransport,
     TransportFault,
 )
+from ..crypto.mac import TAG_LEN as _MAC_TAG_LEN
 from ..testengine.manglers import _flip_bytes, _variant_digest
 from .invariants import (
     CrashSnapshot,
     InvariantViolation,
+    audit_aggregate_certs,
+    check_aggregate_cert_rejected,
     check_bounded_recovery,
     check_censorship_liveness,
     check_commit_resumption,
     check_corruption_rejected,
     check_durable_prefix,
+    check_mac_rejected,
     check_no_fork,
     check_no_fork_under_equivocation,
     check_transfer_corruption_rejected,
@@ -294,11 +298,22 @@ class AdversaryProxy(PartitionProxy):
     Clock-sync hellos and client-proposal frames (the other reserved
     source ids) always pass untouched, as does the reverse pump — real
     peer links are one-way, so only the forward byte stream carries
-    frames."""
+    frames.
 
-    def __init__(self, upstream: tuple, mangle, mangle_transfer=None):
+    ``mangle_raw(source, payload)`` is the byte-level seam for attacks
+    below the message layer — MAC-tag forgery against link-authenticated
+    frames, which must stay structurally parseable so the rejection is
+    attributable to the MAC check alone.  It sees node-lane frames only
+    (reserved source ids pass) and returns a replacement payload or None;
+    when it rewrites a frame, the message-level manglers are skipped (a
+    forged frame never reaches the decoder anyway)."""
+
+    def __init__(
+        self, upstream: tuple, mangle, mangle_transfer=None, mangle_raw=None
+    ):
         self.mangle = mangle
         self.mangle_transfer = mangle_transfer
+        self.mangle_raw = mangle_raw
         super().__init__(upstream)
 
     def _pump(self, src, dst) -> None:
@@ -307,7 +322,9 @@ class AdversaryProxy(PartitionProxy):
         except OSError:
             forward = False
         if not forward or (
-            self.mangle is None and self.mangle_transfer is None
+            self.mangle is None
+            and self.mangle_transfer is None
+            and self.mangle_raw is None
         ):
             return super()._pump(src, dst)
         buf = bytearray()
@@ -344,6 +361,10 @@ class AdversaryProxy(PartitionProxy):
                 return self._rewrite_transfer(payload, offset, original)
             if source >= _HELLO_SRC:
                 return original  # hello / client-proposal frame
+            if self.mangle_raw is not None:
+                twisted = self.mangle_raw(source, payload)
+                if twisted is not None:
+                    return _LEN.pack(len(twisted)) + twisted
             msg = pb.decode(pb.Msg, payload[offset:])
         except ValueError:
             return original  # not ours to judge: the receiver drops it
@@ -416,7 +437,10 @@ class LiveReplica:
             id=node_id,
             batch_size=cluster.scenario.batch_size,
             processor=cluster.processor,
+            link_auth=bool(cluster.auth_secret),
+            auth_secret=cluster.auth_secret,
         )
+        self.config = config
         if initial_state is not None:
             self.node = Node.start_new(config, initial_state)
         else:
@@ -471,6 +495,13 @@ class LiveReplica:
         """Bind the transport; a restart re-binds the node's original
         port (retrying through TIME_WAIT) so the partition proxies'
         upstream addresses stay valid across the reboot."""
+        link_auth = None
+        if self.config.link_auth:
+            from ..crypto.mac import LinkAuthenticator
+
+            link_auth = LinkAuthenticator(
+                self.node_id, self.config.auth_secret
+            )
         deadline = time.monotonic() + 10
         while True:
             try:
@@ -480,6 +511,7 @@ class LiveReplica:
                     backoff_base=0.02,
                     backoff_cap=0.25,
                     dial_timeout=1.0,
+                    link_auth=link_auth,
                 )
             except OSError:
                 if port == 0 or time.monotonic() >= deadline:
@@ -616,6 +648,7 @@ class _LiveAdversary:
         self.censored = 0
         self.corrupted_transfer = 0
         self.censored_transfer = 0
+        self.forged_macs = 0
         self.censored_pairs: set = set()
         self.variants: dict = {}
         self.from_s = cluster.scale_s(spec.from_ms)
@@ -693,6 +726,32 @@ class _LiveAdversary:
         with self._lock:
             self.corrupted_transfer += 1
         return [mutated]
+
+    def applies_to_mac_edge(self, a: int, b: int) -> bool:
+        """Does this adversary forge MAC tags on directed edge a -> b?"""
+        spec = self.spec
+        if spec.kind != "forge_mac":
+            return False
+        if spec.victims and b not in spec.victims:
+            return False
+        return spec.node < 0 or spec.node == a
+
+    def mangle_mac(self, payload: bytes):
+        """Flip one byte of the frame's trailing MAC tag: the frame stays
+        structurally parseable (varints and message body untouched), so
+        the receiver's rejection is attributable to the authenticator
+        check alone.  Returns the forged payload, or None to pass."""
+        if not self.active() or not self.fires():
+            return None
+        if len(payload) <= _MAC_TAG_LEN:
+            return None
+        with self._lock:
+            pos = len(payload) - 1 - self._rng.randrange(_MAC_TAG_LEN)
+            mask = self._rng.randint(1, 255)
+            self.forged_macs += 1
+        forged = bytearray(payload)
+        forged[pos] ^= mask
+        return bytes(forged)
 
     def applies_to_edge(self, a: int, b: int) -> bool:
         """Does this adversary attack frames on directed edge a -> b?"""
@@ -874,6 +933,12 @@ class LiveCluster:
         # censored request first committed anywhere (censorship-liveness
         # evidence, mirroring the deterministic runner).
         self.commit_rotations: dict = {}
+        # MAC-authenticated replica channels: one cluster-wide secret
+        # (derived from the seed so runs are reproducible); every
+        # replica's transport derives per-link keys from it.
+        self.auth_secret = (
+            b"mirbft-live-auth-%d" % seed if scenario.link_auth else b""
+        )
         self.root = tempfile.mkdtemp(prefix=f"mirbft-live-{scenario.name}-")
         self.replicas: list = [None] * scenario.node_count
         self.ports = [0] * scenario.node_count
@@ -935,9 +1000,14 @@ class LiveCluster:
                     upstream = self.replicas[b].transport.address
                     mangle = self._edge_mangler(a, b)
                     mangle_transfer = self._edge_transfer_mangler(a, b)
+                    mangle_raw = self._edge_raw_mangler(a, b)
                     self.proxies[(a, b)] = (
-                        AdversaryProxy(upstream, mangle, mangle_transfer)
-                        if mangle is not None or mangle_transfer is not None
+                        AdversaryProxy(
+                            upstream, mangle, mangle_transfer, mangle_raw
+                        )
+                        if mangle is not None
+                        or mangle_transfer is not None
+                        or mangle_raw is not None
                         else PartitionProxy(upstream)
                     )
         for replica in self.replicas:
@@ -1000,6 +1070,29 @@ class LiveCluster:
             return bodies if changed else None
 
         return mangle_transfer
+
+    def _edge_raw_mangler(self, a: int, b: int):
+        """Compose the MAC-forging adversaries for directed edge a -> b
+        into one raw-payload callback, or None.  Raw manglers see the
+        undecoded node-lane frame payload (varints + body + MAC tag) and
+        may return a replacement payload; they run before, and preempt,
+        the message-level manglers for that frame."""
+        advs = [
+            adv
+            for adv in self.live_adversaries
+            if adv.applies_to_mac_edge(a, b)
+        ]
+        if not advs:
+            return None
+
+        def mangle_raw(source: int, payload: bytes):
+            for adv in advs:
+                forged = adv.mangle_mac(payload)
+                if forged is not None:
+                    return forged
+            return None
+
+        return mangle_raw
 
     def _edges_across(self, groups):
         group_of = {}
@@ -1415,7 +1508,7 @@ def _audit_live_adversaries(scenario, cluster, registry, result) -> None:
     durable commit logs and the adversaries' attack counters.  Raises
     InvariantViolation."""
     advs = cluster.live_adversaries
-    if not advs:
+    if not advs and not scenario.link_auth and not scenario.cert_audit:
         return
     corrupted = sum(adv.corrupted for adv in advs)
     corrupted_proposes = sum(adv.corrupted_proposes for adv in advs)
@@ -1491,6 +1584,66 @@ def _audit_live_adversaries(scenario, cluster, registry, result) -> None:
                     f"to {pending} pending entries for {total} distinct "
                     "requests"
                 )
+    if scenario.link_auth and any(
+        adv.spec.kind == "forge_mac" for adv in advs
+    ):
+        forged = sum(adv.forged_macs for adv in advs)
+        mac_rejections = sum(
+            sum(replica.transport.mac_rejections.values())
+            for replica in cluster.alive_replicas()
+        )
+        result.counters["forged_macs"] = forged
+        result.counters["mac_rejections"] = mac_rejections
+        # Live audit is lossy (a forged frame can die with a torn-down
+        # connection before the receiver's MAC check sees it), so the
+        # bound is 0 < rejections <= forged; the none-accepted half is
+        # held by no-fork/convergence on the durable logs.
+        check_mac_rejected(mac_rejections, forged, exact=False)
+    if scenario.cert_audit:
+        _audit_live_certs(scenario, cluster, result)
+
+
+def _audit_live_certs(scenario, cluster, result) -> None:
+    """Re-derive aggregate checkpoint certificates from the live nodes'
+    captured checkpoints and run the forgery audit through the qc seam:
+    every quorum of matching stable checkpoints yields one BLS aggregate
+    certificate, each genuine certificate must verify, and per-cert
+    forgeries (mismatched statement, wrong signer set) must all be
+    rejected.  Raises InvariantViolation."""
+    from ..crypto import qc
+    from ..testengine.certs import node_seed, statement
+
+    f = (scenario.node_count - 1) // 3
+    quorum = 2 * f + 1
+    stable: dict = {}
+    for replica in cluster.alive_replicas():
+        for seq, (value, _state) in replica.checkpoints.items():
+            stable.setdefault((seq, value), set()).add(replica.node_id)
+    certs: dict = {}
+    for (seq, value), nodes in sorted(stable.items()):
+        signers = tuple(sorted(nodes)[:quorum])
+        if len(signers) < quorum:
+            continue
+        votes = [
+            qc.sign_vote(node_seed(n), statement(seq, value))
+            for n in signers
+        ]
+        certs[(seq, value)] = (
+            signers,
+            qc.aggregate(votes, use_device=False),
+        )
+    if not certs:
+        raise InvariantViolation(
+            "cert audit found no quorum-stable checkpoints (vacuous)"
+        )
+    genuine_ok, genuine_total, forged_rejected, forged_total = (
+        audit_aggregate_certs(certs)
+    )
+    result.counters["certs"] = genuine_total
+    result.counters["cert_forgeries_rejected"] = forged_rejected
+    check_aggregate_cert_rejected(
+        genuine_ok, genuine_total, forged_rejected, forged_total
+    )
 
 
 def run_live_scenario(

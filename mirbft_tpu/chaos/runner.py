@@ -20,6 +20,8 @@ from ..testengine.engine import BasicRecorder
 from .invariants import (
     CrashSnapshot,
     InvariantViolation,
+    audit_aggregate_certs,
+    check_aggregate_cert_rejected,
     check_bounded_recovery,
     check_censorship_liveness,
     check_commit_resumption,
@@ -28,6 +30,7 @@ from .invariants import (
     check_durable_prefix,
     check_flood_bounded,
     check_full_convergence,
+    check_mac_rejected,
     check_no_fork,
     check_no_fork_under_equivocation,
     check_no_vector_divergence,
@@ -182,6 +185,19 @@ def run_scenario(
             if scenario.signature_plane
             else SignaturePlane()
         )
+    mac_plane = None
+    if scenario.link_auth:
+        from ..testengine.signing import MacSealPlane
+
+        mac_plane = MacSealPlane()
+    cert_plane = None
+    if scenario.cert_audit:
+        from ..testengine.certs import CheckpointCertPlane
+
+        # 2f+1 votes make a certificate; host aggregation keeps the
+        # audit portable (the device path is bench.py's concern).
+        f = (scenario.node_count - 1) // 3
+        cert_plane = CheckpointCertPlane(quorum=2 * f + 1, use_device=False)
     rec = BasicRecorder(
         node_count=scenario.node_count,
         client_count=scenario.client_count,
@@ -192,6 +208,8 @@ def run_scenario(
         hash_plane=hash_plane,
         signer=signer,
         signature_plane=signature_plane,
+        mac_plane=mac_plane,
+        checkpoint_certs=cert_plane,
         network_state=(
             scenario.network_state() if scenario.network_state else None
         ),
@@ -511,6 +529,23 @@ def _audit_adversaries(
     if any(hasattr(m, "flooded") for m in manglers):
         result.counters["flooded"] = flooded
         check_flood_bounded(rec, flooded)
+    if scenario.link_auth and rec.mac_plane is not None:
+        # forge_mac lowers to corrupt manglers over replica wire traffic;
+        # the rewrites that were NOT proposal deliveries are the forged
+        # replica messages the MAC layer is obligated to reject.
+        forged = corrupted - corrupted_proposes
+        result.counters["mac_rejections"] = rec.mac_plane.rejections
+        check_mac_rejected(rec.mac_plane.rejections, forged, exact=True)
+    if scenario.cert_audit and rec.checkpoint_certs is not None:
+        certs = rec.checkpoint_certs.certificates()
+        genuine_ok, genuine_total, forged_rejected, forged_total = (
+            audit_aggregate_certs(certs)
+        )
+        result.counters["certs"] = genuine_total
+        result.counters["cert_forgeries_rejected"] = forged_rejected
+        check_aggregate_cert_rejected(
+            genuine_ok, genuine_total, forged_rejected, forged_total
+        )
 
 
 def run_campaign(

@@ -164,9 +164,17 @@ class Adversary:
     * ``flood`` — ``copies`` delayed echoes of matched messages spread
       over ``stale_delay_ms`` (duplication / stale-ack storms against the
       dedup path).  ``msg_kinds=("Propose",)`` storms client submissions.
+    * ``forge_mac`` — tamper with matched replica-channel traffic under
+      MAC-authenticated links (``Scenario.link_auth``).  The
+      deterministic lowering rewrites matched wire messages (fresh,
+      unsealed objects the MacSealPlane must refuse); the live lowering
+      flips raw authenticator-tag bytes at the frame tail, so the frame
+      stays structurally parseable and the rejection is attributable to
+      the MAC check alone.  Counts touches on ``forged_macs`` (live) /
+      the corrupt counters (deterministic).
     """
 
-    kind: str  # "corrupt" | "equivocate" | "censor" | "flood"
+    kind: str  # "corrupt" | "equivocate" | "censor" | "flood" | "forge_mac"
     # The compromised node.  For corrupt/flood over wire messages it
     # scopes from_source; -1 means any source (a compromised network
     # rather than a compromised node).  Corrupting RequestAcks from more
@@ -195,7 +203,10 @@ class Adversary:
         # percent() burns an rng draw per candidate it sees; keep it last
         # so only events the cheap predicates matched consume randomness.
         gate = [percent(self.rate_pct)] if self.rate_pct < 100 else []
-        if self.kind == "corrupt":
+        if self.kind in ("corrupt", "forge_mac"):
+            # forge_mac's deterministic lowering IS a corrupt mangler:
+            # every rewrite builds a fresh, unsealed message object, which
+            # is exactly what the MacSealPlane rejects at delivery.
             if self.msg_kinds == ("Propose",):
                 base = [is_propose()]
             else:
@@ -353,6 +364,15 @@ class Scenario:
     # ingress through a SignaturePlane (factory below, fresh per run).
     signed: bool = False
     signature_plane: object = None  # zero-arg factory (signed mode)
+    # MAC-authenticated replica channels (docs/CRYPTO.md): the
+    # deterministic runner installs a MacSealPlane, the live driver
+    # turns on per-link transport MACs.  Opt-in so digest-layer
+    # corruption scenarios keep observing their evidence where it is.
+    link_auth: bool = False
+    # Post-run aggregate-certificate audit: collect the run's checkpoint
+    # quorum certificates, verify every genuine one and reject every
+    # forged variant through the crypto/qc.py seam.
+    cert_audit: bool = False
     # Byzantine attacks (Adversary specs); both engines lower them.
     adversaries: tuple = ()
     # The scenario is designed to force an epoch change; the runner
@@ -772,6 +792,41 @@ def matrix() -> list:
             tags=("adversary", "epoch", "flood"),
         ),
         Scenario(
+            name="forged-mac-storm",
+            description="MAC-authenticated replica channels: a "
+            "compromised network tampers with 30% of all Prepare/Commit "
+            "traffic for 5s — every forged frame is unsealed and the "
+            "per-link MAC check must reject 100% of them at ingress "
+            "while consensus converges on the honest remainder",
+            link_auth=True,
+            adversaries=(
+                Adversary(
+                    kind="forge_mac",
+                    node=-1,
+                    msg_kinds=("Prepare", "Commit"),
+                    rate_pct=30,
+                    until_ms=5000,
+                ),
+            ),
+            heal_points_ms=(5000,),
+            tags=("adversary", "mac", "live"),
+        ),
+        Scenario(
+            name="forged-aggregate-cert",
+            description="aggregate quorum certificates: checkpoints "
+            "accumulate BLS votes into one aggregate signature per "
+            "certificate; after the run every genuine certificate must "
+            "verify under a single aggregate check and every forged "
+            "variant (mismatched statement, wrong signer set) must be "
+            "rejected — the qc seam's 100%-rejection audit",
+            reqs_per_client=20,
+            cert_audit=True,
+            network_state=_rotating_network_state(
+                max_epoch_length=60, checkpoint_interval=6
+            ),
+            tags=("adversary", "cert", "live"),
+        ),
+        Scenario(
             name="signed-verifier-dies",
             description="signed mode: the signature device raises "
             "mid-run; breaker trips to the host oracle, then a probe "
@@ -975,6 +1030,8 @@ LIVE_ADVERSARY_NAMES = (
     "equivocate-minority-straggler",
     "censor-client-rotation",
     "flood-duplicate-proposes",
+    "forged-mac-storm",
+    "forged-aggregate-cert",
 )
 
 

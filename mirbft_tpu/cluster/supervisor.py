@@ -121,6 +121,9 @@ class ClusterSupervisor:
         network_config: dict | None = None,
         app: str | None = None,
         trace: bool = False,
+        link_auth: bool = False,
+        auth_secret: str = "",
+        signed_ingress: bool = False,
     ):
         if profile not in WAN_PROFILES:
             raise ValueError(
@@ -181,6 +184,14 @@ class ClusterSupervisor:
         # static spec.
         self.network_config = dict(network_config) if network_config else None
         self.app = app  # "kv" installs the replicated KV service per node
+        # Signed-mode knobs (docs/CRYPTO.md): MAC-authenticated replica
+        # channels (all workers share auth_secret) and the speculative
+        # Ed25519 ingress stage for client requests.
+        if link_auth and not auth_secret:
+            raise ValueError("link_auth requires auth_secret")
+        self.link_auth = link_auth
+        self.auth_secret = auth_secret
+        self.signed_ingress = signed_ingress
         # Per-node milestone tracing: each worker dumps <dir>/trace.json
         # (clock_sync-stamped) on graceful shutdown, the input for
         # obsv --critpath / the knee rung's saturation attribution.
@@ -235,6 +246,11 @@ class ClusterSupervisor:
             spec["app"] = self.app
         if self.trace:
             spec["trace"] = True
+        if self.link_auth:
+            spec["link_auth"] = True
+            spec["auth_secret"] = self.auth_secret
+        if self.signed_ingress:
+            spec["signed_ingress"] = True
         return spec
 
     def _spawn(self, handle: _NodeHandle) -> None:

@@ -160,7 +160,10 @@ class Worker:
             batch_size=int(spec.get("batch_size", 1)),
             processor=spec.get("processor", "serial"),
             metrics_port=0,
+            link_auth=bool(spec.get("link_auth", False)),
+            auth_secret=str(spec.get("auth_secret", "")).encode(),
         )
+        self.config = config
         if spec.get("fresh", True):
             # Scenario override (join/catch-up tests shrink the window so
             # a joiner falls a full certified checkpoint behind quickly);
@@ -245,6 +248,21 @@ class Worker:
             chunk_timeout_s=float(spec.get("transfer_chunk_timeout_s", 1.0)),
         )
         self.transport.set_transfer_sink(self.engine.on_frame)
+        # Spec "signed_ingress": client requests carry Ed25519 trailers
+        # (loadgen ClientModel signed=True) and are speculatively
+        # admitted through the batched verify stage — survivors reach
+        # node.propose, forgeries are evicted (docs/CRYPTO.md).
+        self.ingress = None
+        if bool(spec.get("signed_ingress", False)):
+            from ..runtime.ingress import SpeculativeIngress
+            from ..testengine import signing
+
+            self.ingress = SpeculativeIngress(
+                self.node.propose,
+                signing.batch_verifier(),
+                name=f"ingress-{self.node_id}",
+            )
+            self.transport.set_propose_sink(self.ingress.submit)
         self._checkpoint_file = open(
             os.path.join(self.dir, "checkpoints.jsonl"), "a", encoding="utf-8"
         )
@@ -260,12 +278,20 @@ class Worker:
         deadline = time.monotonic() + _BIND_RETRY_S
         while True:
             try:
+                link_auth = None
+                if self.config.link_auth:
+                    from ..crypto.mac import LinkAuthenticator
+
+                    link_auth = LinkAuthenticator(
+                        self.node_id, self.config.auth_secret
+                    )
                 return TcpTransport(
                     self.node_id,
                     port=port,
                     backoff_base=0.02,
                     backoff_cap=0.25,
                     dial_timeout=1.0,
+                    link_auth=link_auth,
                 )
             except OSError:
                 if port == 0 or time.monotonic() >= deadline:
@@ -509,6 +535,8 @@ class Worker:
                 closer()  # drain in-flight batches before storage closes
             except Exception:  # noqa: BLE001 — shutdown is best-effort
                 pass
+        if self.ingress is not None:
+            self.ingress.close(drain_timeout=0.5)
         self.transport.close(0.5)
         self.node.stop()
         self._checkpoint_file.close()

@@ -1,0 +1,82 @@
+"""Aggregate quorum certificates: the sanctioned BLS seam.
+
+A quorum certificate proves 2f+1 replicas vouched for the same
+statement.  Naively that is 2f+1 signature verifications per
+certificate; with BLS aggregation the certificate carries **one** G1
+point and costs one pairing check regardless of quorum size — the
+second leg of the three-tier authentication model (docs/CRYPTO.md).
+
+This module wraps the host reference (`bls_host`) and the device
+aggregation kernel (`ops/bls_g1`) behind a small vote/aggregate/verify
+API so consumers (testengine/certs.py, the chaos cert audits) never
+touch raw pairing primitives — lint rule W21 enforces that boundary.
+Verification outcomes are mirrored to
+``mirbft_cert_aggregate_verifies_total{outcome}`` when hooks are live.
+"""
+
+from __future__ import annotations
+
+from ..obsv import hooks
+from . import bls_host
+
+
+def secret_key(seed: bytes) -> int:
+    return bls_host.secret_key(seed)
+
+
+def public_key(seed: bytes):
+    """Voter public key ([sk]G2) for a vote seed."""
+    return bls_host.public_key(seed)
+
+
+def sign_vote(seed: bytes, statement: bytes):
+    """One replica's G1 vote share over a certificate statement."""
+    return bls_host.sign(seed, statement)
+
+
+def verify_vote(pk, statement: bytes, sig) -> bool:
+    """Individual vote check — the descent primitive when an aggregate
+    fails and the votes are still at hand."""
+    return bls_host.verify(pk, statement, sig)
+
+
+def aggregate(sigs, use_device: bool = True):
+    """Collapse vote shares into one aggregate signature point.
+
+    The device path batches the masked G1 sums through `ops/bls_g1`
+    (bit-equal to host aggregation); the host path is authoritative when
+    no accelerator is attached.  Accepts a list of G1 points, returns
+    one G1 point.
+    """
+    if use_device:
+        try:
+            from ..ops import bls_g1
+
+            return bls_g1.aggregate_signatures([list(sigs)])[0]
+        except Exception:
+            pass
+    return bls_host.aggregate_g1(list(sigs))
+
+
+def _record(outcome: str) -> None:
+    if hooks.enabled:
+        hooks.metrics.counter(
+            "mirbft_cert_aggregate_verifies_total", outcome=outcome
+        ).inc()
+
+
+def verify_cert(pks, statement: bytes, asig) -> bool:
+    """One-shot certificate check: pairing equation over the aggregate.
+
+    ``pks`` are the signer public keys (the certificate's signer
+    bitmap resolved to keys), ``statement`` the certified bytes, and
+    ``asig`` the aggregate G1 point.  A mismatched signer set, tampered
+    statement, or forged point all fail the single pairing check — no
+    per-vote work.
+    """
+    try:
+        ok = bool(bls_host.verify_aggregate(list(pks), statement, asig))
+    except Exception:
+        ok = False
+    _record("ok" if ok else "rejected")
+    return ok

@@ -38,6 +38,13 @@ class ClientModel:
     key_space: int = 64
     key_dist: str = "uniform"
     zipf_s: float = 1.1
+    # Signed mode: the generator wraps every payload with the Ed25519
+    # trailer (testengine/signing wire format: payload || sig || pk) at
+    # plan-build time, so mp/live rungs drive real signed traffic
+    # through the socket path and the replicas' speculative ingress
+    # stage verifies it (docs/CRYPTO.md).  Signing at plan build keeps
+    # retries byte-identical, which dedup requires.
+    signed: bool = False
 
     def __post_init__(self):
         if self.payload_bytes <= 0:

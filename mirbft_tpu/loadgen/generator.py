@@ -99,6 +99,9 @@ class LoadGenerator:
         # advancing, so later steps exercise watermark movement too.
         self._req_no = {client_id: 0 for client_id in self.client_models}
         self._rng = random.Random((seed << 1) ^ 0x85EBCA6B)
+        # Lazy Ed25519 signer for ClientModel.signed traffic; built on
+        # first use so unsigned runs never import the crypto stack.
+        self._signer = None
 
     # -- one rate step -------------------------------------------------------
 
@@ -120,6 +123,15 @@ class LoadGenerator:
             req_no = self._req_no[client_id]
             self._req_no[client_id] += 1
             data = model.payload(self._rng, req_no)
+            if model.signed:
+                # Sign at plan build (not send time): a retry re-submits
+                # the same bytes, and signing off the paced path keeps
+                # the open-loop schedule honest.
+                if self._signer is None:
+                    from ..testengine import signing
+
+                    self._signer = signing.make_signer()
+                data = self._signer(client_id, req_no, data)
             plan.append(
                 (offset + model.submit_lag_s, client_id, req_no, data, model)
             )

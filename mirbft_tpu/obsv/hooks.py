@@ -210,6 +210,15 @@ def record_flush(plane, path, items, seconds=None):
         return
     m.counter("mirbft_crypto_flush_total", plane=plane, path=path).inc()
     m.counter("mirbft_crypto_items_total", plane=plane, path=path).inc(items)
+    if plane == "signature":
+        from .metrics import ACK_BATCH_BUCKETS
+
+        # Burst-size distribution of the batched verify stage: how well
+        # speculative admission is coalescing signature checks (a pile-up
+        # at bucket 1 means the pipeline degenerated to per-item verify).
+        m.histogram(
+            "mirbft_crypto_verify_batch_size", ACK_BATCH_BUCKETS, path=path
+        ).observe(items)
     if seconds is not None:
         m.histogram("mirbft_crypto_flush_seconds", plane=plane).observe(seconds)
     t = tracer

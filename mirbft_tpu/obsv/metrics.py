@@ -49,6 +49,7 @@ CATALOG = {
     "mirbft_byzantine_rejections_total": "Adversarial inputs rejected, by kind (corrupt/equivocate/stale_ack/oversized_batch/oversized_payload/oversized_digest/oversized_snapshot_chunk/malformed).",
     "mirbft_checkpoint_lag_seqnos": "Sequence distance from this node's checkpoint window to the newest 2f+1-certified above-window checkpoint (0 when caught up; the state-transfer trigger).",
     "mirbft_censored_commit_epochs": "Epoch rotations a censored-but-retried request needed before committing, per scenario.",
+    "mirbft_cert_aggregate_verifies_total": "Aggregate-signature certificate verifications through crypto/qc.py, by outcome (ok/rejected).",
     "mirbft_chaos_dropped_total": "Messages dropped by chaos manglers, per scenario.",
     "mirbft_chaos_duplicated_total": "Messages duplicated by chaos manglers, per scenario.",
     "mirbft_chaos_live_recovery_ms": "Live chaos scenario: wall ms from the last heal/restart to convergence.",
@@ -56,6 +57,8 @@ CATALOG = {
     "mirbft_crypto_flush_seconds": "Blocking wall time of one crypto-plane flush/launch/readback.",
     "mirbft_crypto_flush_total": "Crypto-plane flush/launch/readback operations, by plane and path.",
     "mirbft_crypto_items_total": "Digests or signature verdicts produced, by plane and path (device/host/readback/rescued/inline/batch).",
+    "mirbft_crypto_speculative_evictions_total": "Speculatively admitted requests evicted before ordering because their signature verdict came back false.",
+    "mirbft_crypto_verify_batch_size": "Signature-verification burst sizes entering the batched verify stage, by path (rlc/device/ingress/batch/host/readback/rescued).",
     "mirbft_device_hbm_bytes": "Accelerator bytes_in_use reported by the backend's memory_stats (0 on backends without it), sampled by obsv.resources.",
     "mirbft_device_kernel_seconds": "Wall time per instrumented device-plane kernel call (blocking until ready unless the entry point opts out).",
     "mirbft_device_live_buffers": "Live jax arrays held by the process, sampled by obsv.resources.",
@@ -68,6 +71,7 @@ CATALOG = {
     "mirbft_epoch_change_seconds": "Wall time from constructing an epoch change to activating the new epoch, per node observation.",
     "mirbft_epoch_events_total": "Epoch-change milestones (changing/active), by event and epoch.",
     "mirbft_flow_abandoned_total": "Open-flow table entries evicted before a terminal milestone (requests censored/dropped under chaos; bounded-eviction pressure).",
+    "mirbft_mac_rejections_total": "Replica-channel frames rejected by MAC authentication, by kind (bad_mac/short_frame/unsealed).",
     "mirbft_proc_phase_seconds": "Runtime processor wall time per phase (persist/transmit/hash/commit or pooled total).",
     "mirbft_proc_stage_queue_depth": "Pipelined processor: batches queued at each stage hand-off.",
     "mirbft_queue_depth": "Items queued in a bounded hot-path queue, by queue name (emitted only through the obsv.bqueue shim; lint rule W19).",
@@ -120,6 +124,7 @@ CATALOG_LABELS = {
     "mirbft_byzantine_rejections_total": ("kind",),
     "mirbft_checkpoint_lag_seqnos": (),
     "mirbft_censored_commit_epochs": ("scenario",),
+    "mirbft_cert_aggregate_verifies_total": ("outcome",),
     "mirbft_chaos_dropped_total": ("scenario",),
     "mirbft_chaos_duplicated_total": ("scenario",),
     "mirbft_chaos_live_recovery_ms": ("scenario",),
@@ -127,6 +132,8 @@ CATALOG_LABELS = {
     "mirbft_crypto_flush_seconds": ("plane",),
     "mirbft_crypto_flush_total": ("plane", "path"),
     "mirbft_crypto_items_total": ("plane", "path"),
+    "mirbft_crypto_speculative_evictions_total": (),
+    "mirbft_crypto_verify_batch_size": ("path",),
     "mirbft_device_hbm_bytes": (),
     "mirbft_device_kernel_seconds": ("kernel",),
     "mirbft_device_live_buffers": (),
@@ -139,6 +146,7 @@ CATALOG_LABELS = {
     "mirbft_epoch_change_seconds": (),
     "mirbft_epoch_events_total": ("event", "epoch"),
     "mirbft_flow_abandoned_total": (),
+    "mirbft_mac_rejections_total": ("kind",),
     "mirbft_proc_phase_seconds": ("phase",),
     "mirbft_proc_stage_queue_depth": ("stage",),
     "mirbft_queue_depth": ("queue",),
@@ -208,6 +216,13 @@ CARDINALITY = {
     # Closed kind set (network_config/new_client/remove_client/unknown):
     # a typo'd kind must fail loudly instead of minting series.
     "mirbft_reconfig_committed_total": 4,
+    # Closed crypto label spaces: verify paths (the record_flush path
+    # vocabulary: rlc/device/ingress/batch/host/readback/rescued),
+    # rejection kinds (bad_mac/short_frame/unsealed), cert outcomes
+    # (ok/rejected).
+    "mirbft_crypto_verify_batch_size": 8,
+    "mirbft_mac_rejections_total": 4,
+    "mirbft_cert_aggregate_verifies_total": 4,
 }
 
 

@@ -72,6 +72,15 @@ class Config:
     # MIRBFT_SHADOW_STRIDE env knob overrides the sampler default).
     # docs/OBSERVABILITY.md#shadow-oracle.
     shadow_stride: int | None = None
+    # MAC-authenticated replica channels (docs/CRYPTO.md): when on, every
+    # node/hello/transfer transport frame carries a per-link MAC tag
+    # derived from auth_secret (crypto/mac.py) and bad-MAC frames are
+    # rejected at ingress (mirbft_mac_rejections_total).  The client
+    # propose lane stays signature-authenticated.  All members of a
+    # cluster must agree on both knobs — a mixed cluster rejects the
+    # unauthenticated minority's frames by design.
+    link_auth: bool = False
+    auth_secret: bytes = b""
 
     def __post_init__(self):
         if self.logger is None:
@@ -97,3 +106,5 @@ class Config:
             raise ValueError(
                 "max_snapshot_bytes must be >= max_snapshot_chunk_bytes"
             )
+        if self.link_auth and not self.auth_secret:
+            raise ValueError("link_auth requires a non-empty auth_secret")

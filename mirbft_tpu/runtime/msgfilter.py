@@ -27,12 +27,35 @@ class MalformedMessage(ValueError):
     """Preflight rejection.  ``kind`` labels the failure for the
     ``mirbft_byzantine_rejections_total`` taxonomy: ``malformed``
     (structural), ``oversized_batch``, ``oversized_payload``,
-    ``oversized_digest``, or ``oversized_snapshot_chunk`` (state-transfer
-    ingress, see check_snapshot_chunk)."""
+    ``oversized_digest``, ``oversized_snapshot_chunk`` (state-transfer
+    ingress, see check_snapshot_chunk), or ``bad_mac`` (a replica-plane
+    frame whose link MAC failed, see check_frame_mac)."""
 
     def __init__(self, message: str, kind: str = "malformed"):
         super().__init__(message)
         self.kind = kind
+
+
+def check_frame_mac(link_auth, peer: int, payload: bytes):
+    """MAC ingress check for a replica-plane transport frame.
+
+    ``link_auth`` is the node's crypto/mac.LinkAuthenticator, ``peer``
+    the claimed sender (which selects the link key — a forged claim
+    fails the tag like any other tamper).  Returns ``(verified_payload,
+    None)`` with the tag stripped, or ``(None, kind)`` naming the
+    rejection: ``short_frame`` (too short to even carry a tag) or
+    ``bad_mac`` (tag present but wrong).  The transport counts the kind
+    into ``mirbft_mac_rejections_total``; callers that prefer the
+    exception taxonomy can raise ``MalformedMessage(..., kind=kind)``.
+    """
+    from ..crypto.mac import TAG_LEN
+
+    if len(payload) <= TAG_LEN:
+        return None, "short_frame"
+    body = link_auth.open(peer, payload)
+    if body is None:
+        return None, "bad_mac"
+    return body, None
 
 
 def _check_digest(digest: bytes, limit: int, what: str) -> None:

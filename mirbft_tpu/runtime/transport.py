@@ -126,12 +126,14 @@ class LinkLatency:
         return now + self.delay_s
 
 
-def _hello_frame(node_id: int) -> bytes:
+def _hello_frame(node_id: int, link_auth=None, peer_id: int = -1) -> bytes:
     payload = (
         wire.encode_varint(_HELLO_SRC)
         + wire.encode_varint(node_id)
         + wire.encode_varint(time.perf_counter_ns())
     )
+    if link_auth is not None:
+        payload = link_auth.seal(peer_id, payload)
     return _LEN.pack(len(payload)) + payload
 
 
@@ -427,7 +429,13 @@ class _PeerChannel:
                 conn_, send_lock = entry
                 try:
                     with send_lock:
-                        conn_.sendall(_hello_frame(transport.node_id))
+                        conn_.sendall(
+                            _hello_frame(
+                                transport.node_id,
+                                transport.link_auth,
+                                self.peer_id,
+                            )
+                        )
                 except OSError:
                     pass
             return entry
@@ -454,8 +462,20 @@ class TcpTransport:
         backoff_base: float = 0.05,
         backoff_cap: float = 2.0,
         dial_timeout: float = 5.0,
+        link_auth=None,
     ):
         self.node_id = node_id
+        # Per-link MAC authenticator (crypto/mac.LinkAuthenticator) for
+        # the replica plane: node/hello/transfer frames carry a sealed
+        # tag verified (and stripped) at ingress, keyed by the claimed
+        # source.  The client propose lane is exempt — client requests
+        # are authenticated by Ed25519 signatures, not link MACs (the
+        # PBFT split: signatures for requests/certificates, MACs for
+        # replica channels).  None disables authentication entirely.
+        self.link_auth = link_auth
+        # kind -> count of frames rejected at the MAC check; mirrored to
+        # mirbft_mac_rejections_total (chaos evidence + dashboards).
+        self.mac_rejections: dict[str, int] = {}  # guarded-by: _lock
         self.queue_depth = queue_depth
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
@@ -474,6 +494,9 @@ class TcpTransport:
         # Inbound state-transfer frames (see set_transfer_sink); None
         # until a transfer engine attaches, and such frames drop.
         self._transfer_sink = None
+        # Inbound client-lane override (see set_propose_sink); None
+        # routes proposes straight to the node.
+        self._propose_sink = None
         self._peers: dict[int, tuple] = {}  # guarded-by: _lock
         # id -> (socket, per-connection send lock).  The transport-wide
         # _lock guards only the maps; each peer's sends run on its own
@@ -585,8 +608,20 @@ class TcpTransport:
         _LEN.pack_into(buf, 0, len(buf) - _LEN.size)
         return bytes(buf)
 
+    def _sealed_frame(self, dest: int, msg: pb.Msg) -> bytes:
+        """MAC-authenticated framing: the tag covers source id + body and
+        is keyed per destination link, so the scratch fast path (which is
+        destination-independent) does not apply."""
+        payload = self.link_auth.seal(
+            dest, self._src_prefix + pb.encode(msg)
+        )
+        return _LEN.pack(len(payload)) + payload
+
     def _send(self, dest: int, msg: pb.Msg) -> None:
-        frame = self._encode_frame(msg)
+        if self.link_auth is not None:
+            frame = self._sealed_frame(dest, msg)
+        else:
+            frame = self._encode_frame(msg)
         fault = self.fault
         if fault is not None and not fault.on_send(dest, frame):
             with self._lock:
@@ -636,6 +671,8 @@ class TcpTransport:
             + wire.encode_varint(self.node_id)
             + body
         )
+        if self.link_auth is not None:
+            payload = self.link_auth.seal(dest, payload)
         frame = _LEN.pack(len(payload)) + payload
         fault = self.fault
         if fault is not None and not fault.on_send(dest, frame):
@@ -650,6 +687,12 @@ class TcpTransport:
             _frame_outcome("dropped_unknown")
             return
         channel.enqueue(frame)
+
+    def set_propose_sink(self, sink) -> None:
+        """Route inbound client-lane requests through ``sink(request)``
+        instead of ``node.propose`` — the speculative ingress verify
+        stage installs itself here (runtime/ingress.py)."""
+        self._propose_sink = sink
 
     def set_transfer_sink(self, sink) -> None:
         """Install the inbound state-transfer handler: ``sink(sender_id,
@@ -666,6 +709,7 @@ class TcpTransport:
             connected = set(self._conns)
             dropped_unknown = self.dropped_unknown
             dropped_fault = self.dropped_fault
+            mac_rejections = dict(self.mac_rejections)
         peers = {}
         for peer_id, ch in channels.items():
             with ch.cv:
@@ -683,6 +727,7 @@ class TcpTransport:
         return {
             "dropped_unknown": dropped_unknown,
             "dropped_fault": dropped_fault,
+            "mac_rejections": mac_rejections,
             "peers": peers,
         }
 
@@ -749,11 +794,44 @@ class TcpTransport:
         with self._lock:
             return dict(self._clock_offsets)
 
+    def _mac_reject(self, kind: str) -> None:
+        with self._lock:
+            self.mac_rejections[kind] = self.mac_rejections.get(kind, 0) + 1
+        if hooks.enabled:
+            hooks.metrics.counter(
+                "mirbft_mac_rejections_total", kind=kind
+            ).inc()
+
+    def _open_sealed(self, payload: bytes, source: int, offset: int):
+        """MAC ingress check: verify + strip the per-link tag of a
+        replica-plane frame (msgfilter.check_frame_mac).  Returns the
+        verified payload, or None after counting the rejection."""
+        if source in (_HELLO_SRC, _XFER_SRC):
+            # Reserved lanes carry the sender id as the next varint; the
+            # claimed id selects the link key, and a forged claim fails
+            # the tag check like any other tamper.
+            peer, _ = wire.decode_varint(payload, offset)
+        else:
+            peer = source
+        from .msgfilter import check_frame_mac
+
+        body, kind = check_frame_mac(self.link_auth, peer, payload)
+        if body is None:
+            self._mac_reject(kind)
+            return None
+        return body
+
     def _deliver(self, payload: bytes) -> None:
         if self._closed.is_set():
             return  # closed transport must never deliver
         try:
             source, offset = wire.decode_varint(payload, 0)
+            if self.link_auth is not None and source != _PROPOSE_SRC:
+                # Replica-plane frames must carry a valid link MAC; the
+                # client propose lane is signature-authenticated instead.
+                payload = self._open_sealed(payload, source, offset)
+                if payload is None:
+                    return
             if source == _HELLO_SRC:
                 peer_id, offset = wire.decode_varint(payload, offset)
                 remote_ns, _ = wire.decode_varint(payload, offset)
@@ -775,16 +853,27 @@ class TcpTransport:
                 msg = pb.decode(pb.Msg, payload[offset:])
         except ValueError:
             return  # malformed frame from a faulty peer: dropped
+        from .node import NodeStopped
+
+        if source == _PROPOSE_SRC:
+            # Client-lane delivery: the speculative ingress stage (see
+            # set_propose_sink / runtime/ingress.py) takes precedence
+            # over the direct node.propose path.
+            sink = self._propose_sink
+            node = self._node
+            try:
+                if sink is not None:
+                    sink(request)
+                elif node is not None:
+                    node.propose(request)
+            except (ValueError, NodeStopped):
+                pass
+            return
         node = self._node
         if node is None:
             return  # not serving yet: dropped
-        from .node import NodeStopped
-
         try:
-            if source == _PROPOSE_SRC:
-                node.propose(request)
-            else:
-                node.step(source, msg)
+            node.step(source, msg)
         except (ValueError, NodeStopped):
             return  # failed preflight validation / local shutdown: dropped
 

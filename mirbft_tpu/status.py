@@ -456,6 +456,11 @@ class CryptoPlaneStatus:
     fallback_work: int
     device_timeouts: int = 0
     rescued_digests: int = 0
+    # Speculative admission (SpeculativeSignaturePlane / ingress): how
+    # many verdicts are still outstanding and how many admitted requests
+    # were evicted on a false verdict.  Zero for non-speculative planes.
+    speculative_depth: int = 0
+    speculative_evictions: int = 0
     breaker: BreakerStatus | None = None
 
     def to_json(self) -> str:
@@ -468,6 +473,11 @@ class CryptoPlaneStatus:
             f"timeouts={self.device_timeouts} "
             f"fallback={self.fallback_work} rescued={self.rescued_digests}"
         )
+        if self.speculative_depth or self.speculative_evictions:
+            lines.append(
+                f"  speculative: depth={self.speculative_depth} "
+                f"evictions={self.speculative_evictions}"
+            )
         if self.breaker is not None:
             b = self.breaker
             lines.append(
@@ -481,7 +491,8 @@ class CryptoPlaneStatus:
 
 def crypto_plane_status(plane) -> CryptoPlaneStatus:
     """Snapshot a testengine crypto plane (CoalescingHashPlane,
-    AsyncKernelHashPlane, SignaturePlane, or AsyncSignaturePlane)."""
+    AsyncKernelHashPlane, SignaturePlane, AsyncSignaturePlane, or
+    SpeculativeSignaturePlane)."""
     breaker = getattr(plane, "breaker", None)
     breaker_status = None
     if breaker is not None:
@@ -501,6 +512,8 @@ def crypto_plane_status(plane) -> CryptoPlaneStatus:
         fallback_work=getattr(plane, "fallback_digests", 0)
         or getattr(plane, "fallback_verifies", 0),
         rescued_digests=getattr(plane, "rescued_digests", 0),
+        speculative_depth=getattr(plane, "speculative_depth", 0),
+        speculative_evictions=getattr(plane, "speculative_evictions", 0),
         breaker=breaker_status,
     )
 

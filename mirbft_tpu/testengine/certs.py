@@ -17,7 +17,7 @@ nothing back into, the event stream.
 from __future__ import annotations
 
 from .. import pb
-from ..crypto import bls_host
+from ..crypto import qc
 
 
 def node_seed(node_id: int) -> bytes:
@@ -58,7 +58,7 @@ class CheckpointCertPlane:
             # The certificate is already settled (or pending): don't pay a
             # scalar multiplication for a vote that can never be used.
             return
-        votes[node_id] = bls_host.sign(
+        votes[node_id] = qc.sign_vote(
             node_seed(node_id), statement(inner.seq_no, inner.value)
         )
         if len(votes) == self.quorum:
@@ -80,7 +80,7 @@ class CheckpointCertPlane:
 
             aggregated = aggregate_signatures(certs)
         else:
-            aggregated = [bls_host.aggregate_g1(c) for c in certs]
+            aggregated = [qc.aggregate(c, use_device=False) for c in certs]
         for key, asig in zip(keys, aggregated):
             signers = sorted(self._votes[key])[: self.quorum]
             self._certs[key] = (signers, asig)
@@ -93,6 +93,6 @@ class CheckpointCertPlane:
     @staticmethod
     def verify(seq_no: int, value: bytes, signers, asig) -> bool:
         """External check: one pairing equation against the signer set's
-        aggregate public key."""
-        pks = [bls_host.public_key(node_seed(n)) for n in signers]
-        return bls_host.verify_aggregate(pks, statement(seq_no, value), asig)
+        aggregate public key (crypto/qc.py counts the outcome)."""
+        pks = [qc.public_key(node_seed(n)) for n in signers]
+        return qc.verify_cert(pks, statement(seq_no, value), asig)

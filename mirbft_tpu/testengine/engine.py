@@ -173,6 +173,7 @@ class Recorder:
         hash_plane=None,
         signer=None,
         signature_plane=None,
+        mac_plane=None,
         network_state=None,
         checkpoint_certs=None,
         record=True,
@@ -203,6 +204,14 @@ class Recorder:
         # machine sees them.
         self.signer = signer
         self.signature_plane = signature_plane
+        # MAC-authenticated replica channels (signing.MacSealPlane): every
+        # legitimately sent node-to-node message is sealed at emission and
+        # checked at delivery; mangler-forged rewrites are unsealed and
+        # dropped at ingress, mirroring the live transport's per-link MAC
+        # rejection (docs/CRYPTO.md).  Opt-in per scenario: the default
+        # None keeps digest-layer corruption scenarios observing their
+        # evidence where they always did.
+        self.mac_plane = mac_plane
         # Checkpoint quorum certificates (certs.py): every Checkpoint
         # broadcast doubles as a BLS vote; 2f+1 matching votes aggregate
         # into one constant-size certificate.
@@ -674,6 +683,27 @@ class Recorder:
                     event = pb.StateEvent(
                         type=pb.EventProposeBatch(requests=reqs)
                     )
+        if self.mac_plane is not None:
+            inner = event.type
+            if isinstance(inner, pb.EventStep):
+                if not self.mac_plane.admit(inner.msg):
+                    # Replica-channel MAC failed: dropped at ingress,
+                    # unrecorded — the live transport never delivers a
+                    # bad-MAC frame to the node either.
+                    return True
+            elif isinstance(inner, pb.EventStepBatch):
+                admit = self.mac_plane.admit
+                msgs = [m for m in inner.msgs if admit(m)]
+                if len(msgs) != len(inner.msgs):
+                    if not msgs:
+                        return True
+                    # Never mutate the shared event object (other targets
+                    # and the record see the original).
+                    event = pb.StateEvent(
+                        type=pb.EventStepBatch(
+                            source=inner.source, msgs=msgs
+                        )
+                    )
 
         self.event_count += 1
         if self.hash_plane is not None:
@@ -769,11 +799,14 @@ class Recorder:
             if self.checkpoint_certs is not None
             else None
         )
+        seal = self.mac_plane.seal if self.mac_plane is not None else None
         last_targets = None  # sends overwhelmingly share one list object
         last_key = None
         for send in actions.sends:
             if observe is not None:
                 observe(node, send.msg)
+            if seal is not None:
+                seal(send.msg)
             targets = send.targets
             if targets is last_targets:
                 key = last_key
@@ -795,6 +828,8 @@ class Recorder:
                     request_ack=fwd.request_ack, request_data=data
                 )
             )
+            if seal is not None:
+                seal(msg)
             key = tuple(fwd.targets)
             frame = groups.get(key)
             if frame is None:

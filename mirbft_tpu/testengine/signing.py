@@ -155,6 +155,59 @@ def pallas_verifier(items: list) -> list:
     return out
 
 
+def rlc_verifier(items: list) -> list:
+    """items: [(client_id, req_no, data)] -> [bool], via the host batch
+    authority (crypto.ed25519_batch random-linear-combination): one
+    multi-scalar multiplication per chunk instead of two scalar mults per
+    signature.  Verdicts match host_verifier bit-for-bit (the descent
+    leaves decide with the exact oracle equation); the client-identity
+    binding (pk == registry pk) stays per-item."""
+    from ..crypto import ed25519_batch
+
+    cache = _PK_CACHE
+    out = [False] * len(items)
+    triples, slots = [], []
+    for slot, (client_id, req_no, data) in enumerate(items):
+        parts = split_signed(data)
+        if parts is None:
+            continue
+        payload, sig, pk = parts
+        if pk != _expected_pk(client_id, cache):
+            continue
+        triples.append((pk, signing_message(client_id, req_no, payload), sig))
+        slots.append(slot)
+    if slots:
+        for slot, valid in zip(slots, ed25519_batch.verify_batch(triples)):
+            out[slot] = bool(valid)
+    return out
+
+
+def kernel_authority() -> bool:
+    """The device/host verify authority contract (docs/CRYPTO.md): the
+    accelerator batch kernel holds verification authority only when a
+    real device backend is attached; CPU hosts use the host batch
+    authority (RLC), never XLA-on-CPU."""
+    global _KERNEL_AUTHORITY
+    if _KERNEL_AUTHORITY is None:
+        try:
+            import jax
+
+            _KERNEL_AUTHORITY = jax.default_backend() in ("tpu", "gpu")
+        except Exception:
+            _KERNEL_AUTHORITY = False
+    return _KERNEL_AUTHORITY
+
+
+_KERNEL_AUTHORITY: bool | None = None
+
+
+def batch_verifier():
+    """The batch verifier holding authority on this host — what live
+    embedders inject into runtime/ingress.SpeculativeIngress (runtime/
+    itself never imports crypto; see W21)."""
+    return kernel_verifier if kernel_authority() else rlc_verifier
+
+
 class SignaturePlane:
     """Deferred, coalesced request authentication.
 
@@ -508,3 +561,204 @@ class AsyncSignaturePlane(SignaturePlane):
             return
         wave, self._wave = self._wave, []
         self._host_verify_wave(wave)
+
+class SpeculativeSignaturePlane(SignaturePlane):
+    """Speculative batched ingress verification (PR 20's tentpole leg 1).
+
+    Mir's amortization argument: client-signature verification does not
+    have to gate intake — requests may be *admitted optimistically* into
+    the pre-consensus queues (the engine's delivery queue here, the
+    runtime's ingress stage in `runtime/ingress.py`) while their
+    signatures verify as batches off the critical path, as long as the
+    verdict joins before the request can reach the ordered log.
+
+    Mechanics on the deterministic engine:
+
+    - ``submit`` (the client broadcast instant) performs only the cheap
+      structural + client-identity admission and parks the request in the
+      speculative queue — intake is never gated on curve arithmetic.
+    - ``on_time`` (the simulated-time wave boundary, which fires before
+      the first delivery of anything submitted at earlier instants)
+      verifies the parked wave in chunk-bounded bursts: through the
+      accelerator batch kernel (`ops/ed25519.py`, pow2-bucketed rows via
+      ``pack_rows``) when the device holds verify authority, else through
+      the host batch authority (`crypto/ed25519_batch.py`, one
+      multi-scalar multiplication per burst).  Each burst's blocking wall
+      time lands in ``flush_wall_s`` — the rung3 verify p99.
+    - ``valid`` (the delivery join, before the replica steps the state
+      machine) is then an O(1) verdict lookup; a demanded-before-boundary
+      key forces the join early.  A False verdict evicts the
+      speculatively-admitted request — counted here and mirrored to
+      ``mirbft_crypto_speculative_evictions_total`` — so a bad-signature
+      request can be *in flight* but never *ordered*, and
+      ``check_corruption_rejected`` still observes 100% rejection.
+
+    Verdicts depend only on the request bytes (and match the host oracle
+    bit-for-bit), so determinism, event counts, and app chains are
+    unchanged from the synchronous plane.
+    """
+
+    def __init__(
+        self,
+        chunk: int = 64,
+        kernel_chunk: int = 512,
+        breaker=None,
+        timeout_s=None,
+        use_kernel: bool | None = None,
+    ):
+        super().__init__(
+            verifier=rlc_verifier, breaker=breaker, timeout_s=timeout_s
+        )
+        # Host-authority burst width: one RLC combined check per burst.
+        # 64 keeps a burst under the 100ms ingress SLO on a commodity
+        # core while amortizing the MSM over the wave.
+        self.chunk = chunk
+        # Device-authority burst width (pow2-padded tiles are cheap, so
+        # bursts can be much wider before latency matters).
+        self.kernel_chunk = kernel_chunk
+        self._use_kernel = use_kernel
+        self.speculative_evictions = 0
+        self.forced_joins = 0
+        self.admitted = 0
+        self.device_verifies = 0
+        self.host_verifies = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, client_id: int, req_no: int, data: bytes) -> None:
+        key = self._key(client_id, req_no, data)
+        if key in self._verdicts:
+            return
+        parts = split_signed(data)
+        if parts is None:
+            self._verdicts[key] = False
+            return
+        _payload, _sig, pk = parts
+        if pk != _expected_pk(client_id):
+            self._verdicts[key] = False
+            return
+        self._verdicts[key] = None  # pending: speculatively admitted
+        self._pending.append((client_id, req_no, data))
+        self.admitted += 1
+
+    @property
+    def speculative_depth(self) -> int:
+        """Requests currently admitted but not yet judged (status.py)."""
+        return len(self._pending)
+
+    # -- the join ----------------------------------------------------------
+
+    def on_time(self, _now: int) -> None:
+        if self._pending:
+            self._flush()
+
+    def valid(self, client_id: int, req_no: int, data: bytes) -> bool:
+        key = self._key(client_id, req_no, data)
+        verdict = self._verdicts.get(key)
+        if verdict is None:
+            self.submit(client_id, req_no, data)  # no-op if already parked
+            self.forced_joins += 1
+            self._flush()
+            verdict = self._verdicts[key]
+        if not verdict:
+            self.speculative_evictions += 1
+            if hooks.enabled:
+                hooks.metrics.counter(
+                    "mirbft_crypto_speculative_evictions_total"
+                ).inc()
+        return verdict
+
+    # -- burst verification ------------------------------------------------
+
+    def _kernel_path(self) -> bool:
+        if self._use_kernel is not None:
+            return self._use_kernel
+        return kernel_authority()
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        import time
+
+        wave, self._pending = self._pending, []
+        kernel = self._kernel_path() and self.breaker.allow()
+        chunk = self.kernel_chunk if kernel else self.chunk
+        verifier = kernel_verifier if kernel else rlc_verifier
+        path = "device" if kernel else "rlc"
+        for base in range(0, len(wave), chunk):
+            burst = wave[base : base + chunk]
+            start = time.perf_counter()
+            try:
+                verdicts = verifier(burst)
+                if len(verdicts) != len(burst):
+                    raise DevicePlaneError(
+                        f"short read: {len(verdicts)} of {len(burst)}"
+                    )
+            except Exception:
+                # Kernel path died: breaker steers the remaining bursts
+                # (and future waves) to the host batch authority.
+                self.breaker.record_failure()
+                self.device_errors += 1
+                self.fallback_verifies += len(burst)
+                verdicts = rlc_verifier(burst)
+            else:
+                if kernel:
+                    self.breaker.record_success()
+            wall = time.perf_counter() - start
+            self.flush_sizes.append(len(burst))
+            self.flush_wall_s.append(wall)
+            if kernel:
+                self.device_verifies += len(burst)
+            else:
+                self.host_verifies += len(burst)
+            if hooks.enabled:
+                hooks.record_flush("signature", path, len(burst), wall)
+            for item, verdict in zip(burst, verdicts, strict=True):
+                self._verdicts[self._key(*item)] = verdict
+
+
+class MacSealPlane:
+    """Deterministic-engine model of MAC-authenticated replica channels
+    (crypto/mac.py is the live implementation; this is its simulation
+    twin, the way SignaturePlane twins the live ingress verifier).
+
+    The model is identity-based rather than cryptographic: the engine
+    seals every node-to-node message object a legitimate sender emits,
+    and at delivery admits a message only if that exact object was
+    sealed.  Manglers that tamper with replica traffic always *rewrite*
+    (corrupt()/_restep build fresh objects, never mutate — other targets
+    share the original), so a forged or tampered message is by
+    construction unsealed and is dropped at ingress exactly where the
+    live transport drops a bad-MAC frame.  Duplicate deliveries of a
+    sealed object are admitted — PBFT-style link MACs authenticate, they
+    do not prevent replay; dedup owns that (docs/CRYPTO.md).
+
+    Scope: EventStep/EventStepBatch (the replica plane).  Client
+    proposes stay signature-authenticated and state-transfer events are
+    modelled at the digest layer, mirroring the live lane split.
+
+    Sealed objects are pinned by strong reference so an id() can never
+    be recycled into a false admit.  Registry size is bounded by the
+    scenario's total send count — chaos-scale runs, not pod-scale ones.
+    """
+
+    def __init__(self):
+        self._sealed: dict[int, object] = {}
+        self.sealed = 0
+        self.rejections = 0
+
+    def seal(self, msg) -> None:
+        key = id(msg)
+        if key not in self._sealed:
+            self._sealed[key] = msg
+            self.sealed += 1
+
+    def admit(self, msg) -> bool:
+        if id(msg) in self._sealed:
+            return True
+        self.rejections += 1
+        if hooks.enabled:
+            hooks.metrics.counter(
+                "mirbft_mac_rejections_total", kind="unsealed"
+            ).inc()
+        return False

@@ -1206,3 +1206,63 @@ def test_w20_config_mutation_confined_to_adoption_seam(tmp_path):
     harness.parent.mkdir(parents=True)
     harness.write_text("state.config.f = 0\n")
     assert not any("W20" in line for line in lint.check_file(harness))
+
+
+def test_linter_confines_raw_crypto_primitives(tmp_path):
+    """W21: key material and raw verify/MAC primitives (hmac,
+    ed25519_host, bls_host, ed25519_batch) are confined to
+    mirbft_tpu/crypto/, mirbft_tpu/ops/, and testengine/signing.py;
+    every other layer authenticates through the audited seams
+    (crypto.mac, crypto.qc, the signing planes)."""
+    import lint
+
+    # Stdlib hmac in a runtime module: a second truncation/tag choice.
+    sneaky = tmp_path / "mirbft_tpu" / "runtime" / "sneaky_mac.py"
+    sneaky.parent.mkdir(parents=True)
+    sneaky.write_text(
+        "import hmac\n"
+        "tag = hmac.new(b'k', b'm', 'sha256').digest()[:8]\n"
+    )
+    assert any("W21" in line for line in lint.check_file(sneaky)), (
+        lint.check_file(sneaky)
+    )
+
+    # Raw host-math primitives via every import spelling.
+    for i, text in enumerate(
+        (
+            "from ..crypto import ed25519_host\nx = ed25519_host\n",
+            "from mirbft_tpu.crypto.ed25519_host import verify\nx = verify\n",
+            "import mirbft_tpu.crypto.bls_host as b\nx = b\n",
+            "from ..crypto import ed25519_batch\nx = ed25519_batch\n",
+        )
+    ):
+        bad = tmp_path / "mirbft_tpu" / "chaos" / f"sneaky_{i}.py"
+        bad.parent.mkdir(parents=True, exist_ok=True)
+        bad.write_text(text)
+        assert any("W21" in line for line in lint.check_file(bad)), text
+
+    # The sanctioned seams are importable from anywhere in the package.
+    fine = tmp_path / "mirbft_tpu" / "runtime" / "fine_mac.py"
+    fine.write_text(
+        "from ..crypto.mac import TAG_LEN\n"
+        "from ..crypto import qc\n"
+        "x = (TAG_LEN, qc)\n"
+    )
+    assert not any("W21" in line for line in lint.check_file(fine))
+
+    # The confinement's own homes, checked against the real sources.
+    for allowed in (
+        REPO / "mirbft_tpu" / "crypto" / "mac.py",
+        REPO / "mirbft_tpu" / "crypto" / "ed25519_batch.py",
+        REPO / "mirbft_tpu" / "ops" / "ed25519.py",
+        REPO / "mirbft_tpu" / "testengine" / "signing.py",
+    ):
+        assert not any(
+            "W21" in line for line in lint.check_file(allowed)
+        ), allowed
+
+    # Outside the package tree (tests, tools, bench) the rule is off.
+    harness = tmp_path / "tests" / "test_mac.py"
+    harness.parent.mkdir(parents=True)
+    harness.write_text("import hmac\nx = hmac\n")
+    assert not any("W21" in line for line in lint.check_file(harness))

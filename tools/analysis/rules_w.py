@@ -72,6 +72,15 @@ The catalog (rationale per rule lives in docs/ANALYSIS.md):
   committed ``Reconfiguration`` stays the single membership authority;
   a stray ``x.config.field = v`` in an embedder is exactly how two
   nodes end up running divergent configs at the same sequence number.
+- W21 raw crypto primitives (``hmac``, ``ed25519_host``, ``bls_host``,
+  ``ed25519_batch``) imported outside ``mirbft_tpu/crypto/``,
+  ``mirbft_tpu/ops/``, and ``testengine/signing.py`` — key material and
+  raw verify/MAC operations are confined so every caller goes through
+  the audited seams (``crypto.mac`` LinkAuthenticator, ``crypto.qc``
+  vote/aggregate/verify, the signing planes).  A scattered ``hmac.new``
+  or direct curve-math call is exactly how a truncation length, a
+  domain-separation tag, or a validation step silently diverges between
+  two call sites.
 """
 
 from __future__ import annotations
@@ -313,6 +322,33 @@ def in_app_state_io_ban_scope(posix: str) -> bool:
         "mirbft_tpu/" in posix
         and not posix.endswith(APP_STATE_IO_ALLOWED_FILE)
         and APP_STATE_IO_ALLOWED_TREE not in posix
+    )
+
+
+# Raw crypto primitive modules: stdlib hmac (key material flows through
+# it) and the host-math references.  Importing any of them outside the
+# crypto/ops layers and the engines' signing planes trips W21; everyone
+# else authenticates through the audited seams (crypto.mac, crypto.qc,
+# the signature planes), which own truncation lengths, domain tags, and
+# validation order.
+CRYPTO_PRIMITIVE_MODULES = (
+    "hmac",
+    "ed25519_host",
+    "bls_host",
+    "ed25519_batch",
+)
+CRYPTO_PRIMITIVE_ALLOWED_TREES = ("mirbft_tpu/crypto/", "mirbft_tpu/ops/")
+CRYPTO_PRIMITIVE_ALLOWED_FILE = "mirbft_tpu/testengine/signing.py"
+
+
+def in_crypto_primitive_ban_scope(posix: str) -> bool:
+    """True for mirbft_tpu files where W21 bans raw-primitive imports."""
+    return (
+        "mirbft_tpu/" in posix
+        and not any(
+            tree in posix for tree in CRYPTO_PRIMITIVE_ALLOWED_TREES
+        )
+        and not posix.endswith(CRYPTO_PRIMITIVE_ALLOWED_FILE)
     )
 
 
@@ -633,6 +669,42 @@ def _check_w14(ctx: FileContext):
                 node.lineno,
                 "resource/psutil outside obsv/resources.py (process "
                 "introspection goes through the obsv resource sampler)",
+            )
+
+
+def _check_w21(ctx: FileContext):
+    def primitive_in(dotted: str) -> str | None:
+        for part in dotted.split("."):
+            if part in CRYPTO_PRIMITIVE_MODULES:
+                return part
+        return None
+
+    for node in ast.walk(ctx.tree):
+        hits = []
+        if isinstance(node, ast.Import):
+            hits = [
+                name
+                for alias in node.names
+                if (name := primitive_in(alias.name)) is not None
+            ]
+        elif isinstance(node, ast.ImportFrom):
+            name = primitive_in(node.module or "")
+            if name is not None:
+                hits = [name]
+            else:
+                hits = [
+                    alias.name
+                    for alias in node.names
+                    if alias.name in CRYPTO_PRIMITIVE_MODULES
+                ]
+        for name in hits:
+            yield Finding(
+                "W21",
+                ctx.path,
+                node.lineno,
+                f"raw crypto primitive '{name}' outside crypto//ops//"
+                "testengine/signing.py (authenticate through crypto.mac, "
+                "crypto.qc, or the signing planes)",
             )
 
 
@@ -1179,6 +1251,23 @@ register(
         ),
         check=_as_list(_check_w20),
         scope=in_config_mutation_ban_scope,
+    )
+)
+register(
+    Rule(
+        id="W21",
+        title="raw crypto primitives outside the crypto layer",
+        doc=(
+            "hmac / ed25519_host / bls_host / ed25519_batch imports are "
+            "confined to mirbft_tpu/crypto/, mirbft_tpu/ops/, and "
+            "testengine/signing.py; every other layer authenticates "
+            "through the audited seams (crypto.mac LinkAuthenticator, "
+            "crypto.qc vote/aggregate/verify, the signing planes) so "
+            "truncation lengths, domain tags, and validation order "
+            "cannot diverge between call sites."
+        ),
+        check=_as_list(_check_w21),
+        scope=in_crypto_primitive_ban_scope,
     )
 )
 register(
